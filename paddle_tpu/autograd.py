@@ -1,0 +1,71 @@
+"""paddle.autograd namespace.
+
+Analog of reference python/paddle/autograd/ (backward via
+imperative/basic_engine.cc, paddle.grad via partial_grad_engine.cc).
+"""
+from .core.tape import backward, grad, no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer"]
+
+
+class PyLayer:
+    """Custom autograd op (reference python/paddle/autograd/py_layer.py).
+
+    Subclass with static `forward(ctx, *args)` / `backward(ctx, *grads)`.
+    """
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        import jax
+        from .core.tape import Node, is_grad_enabled, _wrap_outputs
+        from .core.tensor import Tensor
+
+        ctx = _PyLayerContext()
+        raw = [a._value if isinstance(a, Tensor) else a for a in args]
+        out_val = cls.forward(ctx, *raw, **kwargs)
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        any_diff = any(not a.stop_gradient for a in tensor_inputs)
+        if not (is_grad_enabled() and any_diff):
+            return _wrap_outputs(out_val, node=None, stop_gradient=True)
+
+        multi = isinstance(out_val, (tuple, list))
+        outs = list(out_val) if multi else [out_val]
+
+        def vjp_fn(cot):
+            grads = cls.backward(ctx, *(cot if multi else (cot,)))
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            if len(grads) != len(tensor_inputs):
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(grads)} grads for "
+                    f"{len(tensor_inputs)} tensor inputs")
+            # engine drops entries for stop_gradient inputs, keeping alignment
+            return tuple(g._value if isinstance(g, Tensor) else g for g in grads)
+
+        node = Node(vjp_fn, tensor_inputs,
+                    [(tuple(o.shape), o.dtype) for o in outs],
+                    cls.__name__, multi)
+        return _wrap_outputs(out_val, node=node, stop_gradient=False)
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+
+class _PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = saved_tensor

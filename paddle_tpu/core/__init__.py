@@ -1,0 +1,2 @@
+from . import dtype, flags, rng, tape, tensor  # noqa: F401
+from .tensor import Tensor, to_tensor  # noqa: F401

@@ -1,0 +1,74 @@
+"""Dtype registry.
+
+TPU-native analog of the reference's `VarType` proto enum
+(reference: paddle/fluid/framework/framework.proto:104) and the
+float16/bfloat16 platform types (platform/float16.h, platform/bfloat16.h).
+Here dtypes are plain jnp dtypes with paddle-style string names; bfloat16 is
+the first-class reduced precision type (TPU MXU native), float16 is kept for
+API parity.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+FLOATING = {"float16", "bfloat16", "float32", "float64"}
+INTEGER = {"uint8", "int8", "int16", "int32", "int64"}
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (string / np / jnp dtype) to a canonical name."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype in _NAME_TO_DTYPE:
+            return dtype
+        raise TypeError(f"unsupported dtype string: {dtype!r}")
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else np.dtype(dtype).name
+    # np.dtype(bfloat16).name == 'bfloat16' via ml_dtypes
+    if name in _NAME_TO_DTYPE:
+        return name
+    raise TypeError(f"unsupported dtype: {dtype!r}")
+
+
+def to_jax_dtype(dtype):
+    """Any dtype spec -> jnp dtype object."""
+    if dtype is None:
+        return None
+    return _NAME_TO_DTYPE[convert_dtype(dtype)]
+
+
+def is_floating(dtype) -> bool:
+    return convert_dtype(dtype) in FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in INTEGER

@@ -1,0 +1,69 @@
+"""Global flag registry.
+
+TPU-native analog of the reference's gflags backbone
+(reference: paddle/fluid/platform/flags.cc:33-565 and
+pybind/global_value_getter_setter.cc): flags are declared once with a type
+and default, may be seeded from `FLAGS_*` environment variables at import
+time (matching fluid/__init__.py __bootstrap__), and are get/set-able at
+runtime via `paddle_tpu.set_flags` / `get_flags`.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+_LOCK = threading.Lock()
+_REGISTRY: Dict[str, Any] = {}
+_DEFS: Dict[str, tuple] = {}  # name -> (type, default, help)
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    ftype = type(default)
+    with _LOCK:
+        _DEFS[name] = (ftype, default, help_str)
+        env = os.environ.get(name)
+        if env is not None:
+            _REGISTRY[name] = _parse(ftype, env)
+        else:
+            _REGISTRY[name] = default
+
+
+def _parse(ftype, text: str):
+    if ftype is bool:
+        return text.strip().lower() in ("1", "true", "yes", "on")
+    return ftype(text)
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags equivalent."""
+    with _LOCK:
+        for name, value in flags.items():
+            if name not in _DEFS:
+                raise KeyError(f"unknown flag {name!r}")
+            ftype = _DEFS[name][0]
+            _REGISTRY[name] = _parse(ftype, value) if isinstance(value, str) and ftype is not str else ftype(value)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    with _LOCK:
+        return {name: _REGISTRY[name] for name in flags}
+
+
+def flag(name: str):
+    """Fast internal accessor."""
+    return _REGISTRY[name]
+
+
+# Core flag set (subset of reference platform/flags.cc relevant to TPU).
+define_flag("FLAGS_check_nan_inf", False,
+            "validate op outputs for nan/inf each step (reference platform/flags.cc:44)")
+define_flag("FLAGS_benchmark", False, "sync and time each op")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "GC threshold (no-op on XLA; kept for parity)")
+define_flag("FLAGS_use_bf16_matmul", True, "prefer bfloat16 matmul accumulation on MXU")
+define_flag("FLAGS_seed", 0, "global random seed")
+define_flag("FLAGS_log_level", 0, "verbose log level (glog VLOG equivalent)")
+define_flag("FLAGS_allocator_strategy", "xla", "kept for parity; XLA owns device memory")
+define_flag("FLAGS_enable_profiler", False, "enable host event profiler")

@@ -1,0 +1,64 @@
+"""Random state.
+
+The reference threads per-device curand generators through DeviceContext
+(reference: paddle/fluid/platform/device_context.h:297). TPU-native design:
+one functional PRNG key chain (jax.random) held in a `Generator`. Eager ops
+split the key per call; traced training steps re-seat the chain on an
+explicit per-step key (see hapi/model.py) so compiled steps get fresh
+randomness without retracing.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class Generator:
+    """A splittable PRNG chain. `next_key()` advances the chain."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.PRNGKey(seed)
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int):
+        self._key = jax.random.PRNGKey(seed)
+        return self
+
+    def seat(self, key):
+        """Replace the chain head (used by jitted steps to thread step keys)."""
+        self._key = key
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+
+_default = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default
+
+
+def seed(value: int):
+    """paddle.seed equivalent."""
+    _default.manual_seed(int(value))
+    return _default
+
+
+def next_key():
+    return _default.next_key()
+
+
+@contextlib.contextmanager
+def rng_state(key):
+    """Temporarily seat the global chain on `key` (used inside traced steps)."""
+    old = _default._key
+    _default.seat(key)
+    try:
+        yield
+    finally:
+        _default.seat(old)

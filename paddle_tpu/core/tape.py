@@ -1,0 +1,341 @@
+"""Dygraph autograd engine.
+
+TPU-native analog of the reference's imperative stack:
+  - op recording       ~ Tracer::TraceOp (reference: paddle/fluid/imperative/tracer.cc:59)
+  - grad graph node    ~ OpBase + GradOpNode (imperative/layer.h)
+  - backward executor  ~ BasicEngine::Init/PrepareDeps/Execute
+                         (imperative/basic_engine.cc:39,148,185)
+  - multi-consumer sum ~ GradientAccumulator (imperative/gradient_accumulator.cc)
+  - paddle.grad        ~ PartialGradEngine (imperative/partial_grad_engine.cc)
+
+Design delta (SURVEY.md §7.1): instead of per-op hand-written grad kernels
+chosen through GradOpDescMaker, every eager op is executed through `jax.vjp`,
+which both computes the forward value and returns the exact cotangent
+function XLA would differentiate under jit. The graph is implicit — each
+output Tensor links to its producing Node — so Python GC frees dead
+subgraphs with no global tape list (the reference needs eager GC passes for
+the same job, framework/executor_gc_helper.cc).
+
+The same op wrappers run unmodified under `jax.jit` tracing (values are then
+tracers and recording is usually disabled), which is how the compiled
+training paths (hapi, static.Program) reuse this single op library.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = [
+    "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
+    "Node", "record_op", "backward", "grad",
+]
+
+_state = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+class _GradScope:
+    """Usable as context manager AND decorator, like paddle.no_grad."""
+
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __call__(self, func=None):
+        if func is None:
+            return self
+        import functools
+
+        @functools.wraps(func)
+        def inner(*a, **k):
+            with _GradScope(self._mode):
+                return func(*a, **k)
+        return inner
+
+    def __enter__(self):
+        self._old = is_grad_enabled()
+        set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._old)
+        return False
+
+
+def no_grad(func=None):
+    scope = _GradScope(False)
+    return scope(func) if func is not None else scope
+
+
+def enable_grad(func=None):
+    scope = _GradScope(True)
+    return scope(func) if func is not None else scope
+
+
+_seq_lock = threading.Lock()
+_seq_counter = [0]
+
+
+class Node:
+    """One recorded op: holds the vjp closure and edges to differentiable inputs."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "seq", "name", "multi_out",
+                 "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_avals, name, multi_out):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs          # list[Tensor] — differentiable inputs only
+        self.out_avals = out_avals    # list[(shape, dtype)]
+        self.name = name
+        self.multi_out = multi_out
+        with _seq_lock:
+            _seq_counter[0] += 1
+            self.seq = _seq_counter[0]
+
+
+def record_op(fn: Callable, args: Sequence[Any], kwargs: dict, name: str = None):
+    """Execute `fn` on raw values, recording a grad Node if needed.
+
+    `fn` is a pure function of raw jax arrays (plus static kwargs). Tensor
+    arguments are unwrapped; if recording is on and any floating Tensor input
+    has stop_gradient=False, the op is run under jax.vjp and its outputs are
+    linked into the implicit graph.
+    """
+    from .tensor import Tensor  # cycle: Tensor uses record_op for operators
+
+    is_t = lambda v: isinstance(v, Tensor)  # noqa: E731
+    # Flatten kwargs so keyword Tensors (e.g. layer_norm(x, weight=w)) are
+    # first-class differentiable inputs, not closure constants.
+    kw_leaves, kw_tree = jax.tree_util.tree_flatten(kwargs, is_leaf=is_t)
+    flat = list(args) + kw_leaves
+    n_args = len(args)
+    raw = [a._value if is_t(a) else a for a in flat]
+
+    def _diffable(a):
+        v = a._value
+        dt = v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype
+        return not a.stop_gradient and np.issubdtype(dt, np.inexact)
+
+    diff_idx = [i for i, a in enumerate(flat)
+                if is_t(a) and _diffable(a)] if is_grad_enabled() else []
+
+    def _call(full):
+        kw = jax.tree_util.tree_unflatten(kw_tree, full[n_args:])
+        return fn(*full[:n_args], **kw)
+
+    if not diff_idx:
+        out_val = _call(raw)
+        return _wrap_outputs(out_val, node=None, stop_gradient=True)
+
+    def closed(*diff_vals):
+        full = list(raw)
+        for i, v in zip(diff_idx, diff_vals):
+            full[i] = v
+        return _call(full)
+
+    out_val, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+    multi_out = isinstance(out_val, (tuple, list))
+    outs = list(out_val) if multi_out else [out_val]
+    out_avals = [(tuple(o.shape), o.dtype) for o in outs]
+    node = Node(vjp_fn, [flat[i] for i in diff_idx], out_avals,
+                name or getattr(fn, "__name__", "op"), multi_out)
+    return _wrap_outputs(out_val, node=node, stop_gradient=False)
+
+
+def _wrap_outputs(out_val, node, stop_gradient):
+    from .tensor import Tensor
+
+    def wrap_one(v, idx):
+        sg = stop_gradient
+        if hasattr(v, "dtype") and not np.issubdtype(v.dtype, np.inexact):
+            sg = True  # integer/bool outputs never carry grad
+        t = Tensor(v, stop_gradient=sg, _internal=True)
+        if node is not None and not sg:
+            t._node = node
+            t._out_index = idx
+        return t
+
+    if isinstance(out_val, (tuple, list)):
+        return tuple(wrap_one(v, i) for i, v in enumerate(out_val))
+    return wrap_one(out_val, 0)
+
+
+def _zero_cot(shape, dtype):
+    if np.issubdtype(dtype, np.inexact):
+        import jax.numpy as jnp
+        return jnp.zeros(shape, dtype)
+    return np.zeros(shape, jax.dtypes.float0)
+
+
+def _run_engine(seeds, accumulate_leaf=True, capture=None, retain_graph=False):
+    """Reverse-topological sweep.
+
+    seeds: list[(tensor, cotangent_array)]
+    capture: optional dict id(tensor)->slot to collect grads for paddle.grad
+    """
+    # cot maps (node_id, out_index) -> accumulated cotangent
+    cot = {}
+    node_by_id = {}
+    leaf_grads = {}
+
+    def seed_tensor(t, g):
+        if t._node is None:
+            key = id(t)
+            leaf_grads[key] = g if key not in leaf_grads else leaf_grads[key] + g
+        else:
+            k = (id(t._node), t._out_index)
+            node_by_id[id(t._node)] = t._node
+            cot[k] = g if k not in cot else cot[k] + g
+
+    for t, g in seeds:
+        seed_tensor(t, g)
+
+    # reachable set
+    seen = set()
+    stack = [t._node for t, _ in seeds if t._node is not None]
+    order = []
+    while stack:
+        n = stack.pop()
+        if n is None or id(n) in seen:
+            continue
+        seen.add(id(n))
+        node_by_id[id(n)] = n
+        order.append(n)
+        for inp in n.inputs:
+            if inp._node is not None:
+                stack.append(inp._node)
+
+    # process in reverse creation order (valid topological order)
+    order.sort(key=lambda n: n.seq, reverse=True)
+
+    for n in order:
+        outs_cot = [cot.pop((id(n), i), None) for i in range(len(n.out_avals))]
+        if all(c is None for c in outs_cot):
+            continue
+        full = [c if c is not None else _zero_cot(*n.out_avals[i])
+                for i, c in enumerate(outs_cot)]
+        if n.vjp_fn is None:
+            raise RuntimeError(
+                f"grad graph for op '{n.name}' was already freed; "
+                "pass retain_graph=True to backward() to reuse it")
+        arg = tuple(full) if n.multi_out else full[0]
+        in_cots = n.vjp_fn(arg)
+        if not retain_graph:
+            n.vjp_fn = None  # free residual memory, like eager GC of grad graph
+        for inp, g in zip(n.inputs, in_cots):
+            if isinstance(g, np.ndarray) and g.dtype == jax.dtypes.float0:
+                continue
+            if g is None or inp.stop_gradient:
+                continue  # PyLayer may list non-diff inputs; drop their cots
+            if inp._node is not None:
+                k = (id(inp._node), inp._out_index)
+                cot[k] = g if k not in cot else cot[k] + g
+            else:
+                key = id(inp)
+                leaf_grads[key] = g if key not in leaf_grads else leaf_grads[key] + g
+            if capture is not None and id(inp) in capture:
+                capture[id(inp)] = (g if capture[id(inp)] is None
+                                    else capture[id(inp)] + g)
+
+    return leaf_grads
+
+
+def backward(tensor, grad_tensor=None, retain_graph=False):
+    """Tensor.backward(): accumulate .grad on leaf tensors."""
+    import jax.numpy as jnp
+    from .tensor import Tensor
+
+    if tensor.stop_gradient:
+        raise RuntimeError("backward() on a tensor with stop_gradient=True")
+    if grad_tensor is None:
+        g = jnp.ones(tensor.shape, tensor._value.dtype)
+    else:
+        g = grad_tensor._value if isinstance(grad_tensor, Tensor) else grad_tensor
+
+    # track leaves reachable so we can assign .grad; walk graph collecting leaf tensors
+    leaves = {}
+    stack = [tensor]
+    seen_nodes = set()
+    while stack:
+        t = stack.pop()
+        if t._node is None:
+            leaves[id(t)] = t
+            continue
+        if id(t._node) in seen_nodes:
+            continue
+        seen_nodes.add(id(t._node))
+        stack.extend(t._node.inputs)
+
+    leaf_grads = _run_engine([(tensor, g)], retain_graph=retain_graph)
+    if tensor._node is None:
+        leaf_grads.setdefault(id(tensor), g)
+
+    for key, gval in leaf_grads.items():
+        leaf = leaves.get(key)
+        if leaf is None and key == id(tensor):
+            leaf = tensor
+        if leaf is None:
+            continue
+        if leaf.grad is None:
+            leaf.grad = Tensor(gval, stop_gradient=True, _internal=True)
+        else:
+            leaf.grad = Tensor(leaf.grad._value + gval, stop_gradient=True,
+                               _internal=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """paddle.grad equivalent (PartialGradEngine, partial_grad_engine.cc).
+
+    Returns grads of `outputs` w.r.t. `inputs` without touching .grad.
+    create_graph (double backward) is not yet supported.
+    """
+    import jax.numpy as jnp
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double backward): use paddle_tpu.incubate."
+            "functional (jax.grad composition) for higher-order derivatives")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    seeds = []
+    for o, go in zip(outputs, grad_outputs):
+        g = (go._value if isinstance(go, Tensor) else go) if go is not None \
+            else jnp.ones(o.shape, o._value.dtype)
+        seeds.append((o, g))
+
+    capture = {id(t): None for t in inputs}
+    retain = bool(retain_graph) if retain_graph is not None else False
+    leaf_grads = _run_engine(seeds, capture=capture, retain_graph=retain)
+
+    results = []
+    for t in inputs:
+        gval = capture[id(t)]
+        if gval is None:
+            gval = leaf_grads.get(id(t))
+        if gval is None:
+            # output may BE the input
+            for o, g in seeds:
+                if o is t:
+                    gval = g
+        if gval is None:
+            if not allow_unused:
+                raise RuntimeError("one of the inputs was not used in the graph "
+                                   "(pass allow_unused=True to get None)")
+            results.append(None)
+        else:
+            results.append(Tensor(gval, stop_gradient=True, _internal=True))
+    return results

@@ -1,0 +1,228 @@
+"""Tensor: the user-facing n-d array.
+
+TPU-native analog of the reference's VarBase/LoDTensor pair
+(reference: paddle/fluid/imperative/layer.h:65 VarBase;
+paddle/fluid/framework/tensor.h:77 Tensor; lod_tensor.h LoDTensor).
+
+Design deltas (SURVEY.md §7.1):
+  - storage is a jax.Array (XLA-managed, device-resident) or a tracer while
+    inside a jit trace — the same Tensor class flows through eager AND
+    compiled paths, replacing the reference's dual VarBase/Variable split.
+  - no LoD: ragged sequences are represented densely with masks/segment ids
+    (see paddle_tpu.text utilities), which is the XLA-friendly layout.
+  - autograd linkage is `_node/_out_index` into the tape (core/tape.py),
+    replacing VarBase's GradVarBase + inplace version counter.
+Tensor is registered as a jax pytree node so jit/grad/shard transforms can
+cross Tensor boundaries transparently.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+from . import tape as _tape
+
+__all__ = ["Tensor", "to_tensor"]
+
+
+def _coerce(value, dtype=None):
+    if isinstance(value, Tensor):
+        value = value._value
+    jd = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    if isinstance(value, jax.Array) or isinstance(value, jax.core.Tracer):
+        return value.astype(jd) if jd is not None and value.dtype != jd else value
+    arr = np.asarray(value)
+    if jd is None:
+        # paddle defaults: python floats -> float32, ints -> int64
+        if arr.dtype == np.float64:
+            jd = jnp.float32
+        elif arr.dtype == np.int64 or arr.dtype == np.int32:
+            jd = jnp.int64 if arr.dtype == np.int64 else arr.dtype
+    return jnp.asarray(arr, dtype=jd)
+
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "grad", "name", "persistable",
+                 "trainable", "_node", "_out_index", "__weakref__")
+
+    def __init__(self, value, dtype=None, stop_gradient=True, name=None,
+                 persistable=False, _internal=False):
+        if _internal:
+            self._value = value
+        else:
+            self._value = _coerce(value, dtype)
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.name = name
+        self.persistable = persistable
+        self.trainable = not stop_gradient
+        self._node = None
+        self._out_index = 0
+
+    # -- raw access ---------------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return tuple(int(s) for s in self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return np.asarray(self._value).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        return (f"Tensor(shape={list(self.shape)}, dtype={self.dtype.name}, "
+                f"stop_gradient={self.stop_gradient},\n{self._value})")
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        return bool(np.asarray(self._value))
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __index__(self):
+        return int(np.asarray(self._value))
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        _tape.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def detach(self):
+        t = Tensor(self._value, stop_gradient=True, _internal=True)
+        t.name = self.name
+        return t
+
+    def clone(self):
+        from .. import ops
+        return ops.assign(self)
+
+    def register_hook(self, hook):
+        raise NotImplementedError("tensor hooks: planned (imperative/hooks.h parity)")
+
+    # -- mutation (rebinds value; autograd-safe SSA rebind) -----------------
+    def set_value(self, value):
+        v = _coerce(value)
+        if tuple(v.shape) != self.shape:
+            raise ValueError(f"set_value shape mismatch {v.shape} vs {self.shape}")
+        self._value = v.astype(self._value.dtype)
+        self._node = None
+        self._out_index = 0
+        return self
+
+    def copy_(self, other, *a):
+        return self.set_value(other)
+
+    def _rebind(self, new):
+        """Adopt another Tensor's value and autograd linkage (in-place ops)."""
+        self._value = new._value
+        self._node = new._node
+        self._out_index = new._out_index
+        self.stop_gradient = new.stop_gradient
+        return self
+
+    def _alias(self):
+        """Snapshot sharing value AND autograd linkage (unlike detach).
+
+        Used by in-place ops: the op must consume the tensor's *pre-write*
+        identity so the rebind cannot make the grad graph cyclic — the SSA
+        discipline the reference enforces with inplace version counters
+        (reference: paddle/fluid/framework/tensor.h:77-89).
+        """
+        t = Tensor(self._value, stop_gradient=self.stop_gradient,
+                   _internal=True)
+        t._node = self._node
+        t._out_index = self._out_index
+        t.name = self.name
+        return t
+
+    # -- conversion / shape sugar (defined via ops; populated lazily) ------
+    def astype(self, dtype):
+        from .. import ops
+        return ops.cast(self, dtype)
+
+    cast = astype
+
+    def __getitem__(self, idx):
+        from .. import ops
+        return ops.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        from .. import ops
+        self._rebind(ops.setitem(self._alias(), idx, value))
+
+    # arithmetic operators are attached by ops/_bind.py to avoid an import
+    # cycle; see paddle_tpu/ops/_bind.py.
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor equivalent (place is accepted for parity; XLA owns
+    placement — use paddle_tpu.distributed shardings for multi-device)."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._value if dtype is None else _coerce(data._value, dtype),
+                   stop_gradient=stop_gradient, _internal=dtype is None)
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+# -- pytree registration ----------------------------------------------------
+def _flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient, t.name, t.persistable)
+
+
+def _unflatten(aux, children):
+    t = Tensor(children[0], stop_gradient=aux[0], name=aux[1],
+               persistable=aux[2], _internal=True)
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _flatten, _unflatten)

@@ -1,0 +1,78 @@
+"""paddle.save / paddle.load.
+
+Analog of reference python/paddle/fluid/dygraph/checkpoint.py (save_dygraph /
+load_dygraph) and framework/save_load_util.cc tensor serialization. Format:
+a single pickle file whose tensor leaves are numpy arrays plus a small
+header recording the framework version — step-atomic (write temp + rename),
+matching the reference's save-op semantics (operators/save_op.cc).
+Multi-host sharded checkpointing lives in paddle_tpu.incubate.checkpoint
+(orbax-backed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_MAGIC = "paddle_tpu.checkpoint.v1"
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return _NDArrayLeaf(np.asarray(obj._value), True)
+    if hasattr(obj, "dtype") and hasattr(obj, "shape"):  # jax/np array
+        return _NDArrayLeaf(np.asarray(obj), False)
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_serializable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+class _NDArrayLeaf:
+    __slots__ = ("array", "was_tensor")
+
+    def __init__(self, array, was_tensor):
+        self.array = array
+        self.was_tensor = was_tensor
+
+
+def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, _NDArrayLeaf):
+        if return_numpy or not obj.was_tensor:
+            return obj.array
+        return Tensor(obj.array)
+    if isinstance(obj, dict):
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_serializable(v, return_numpy) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    payload = {"magic": _MAGIC, "data": _to_serializable(obj)}
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(payload, f, protocol=protocol)
+        os.replace(tmp, path)  # atomic publish
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load(path, return_numpy=False):
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    if not (isinstance(payload, dict) and payload.get("magic") == _MAGIC):
+        return payload  # foreign pickle; hand back as-is
+    return _from_serializable(payload["data"], return_numpy)

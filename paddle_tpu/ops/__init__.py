@@ -1,0 +1,19 @@
+"""paddle_tpu.ops — the op library (single jnp/lax kernel per op).
+
+TPU-native replacement for the reference's operator library
+(reference: paddle/fluid/operators/, 737 REGISTER_OPERATOR sites — see
+SURVEY.md N30). Dispatch model in _dispatch.py.
+"""
+from ._dispatch import OP_REGISTRY, defop  # noqa: F401
+from .math import *          # noqa: F401,F403
+from .creation import *      # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .reduction import *     # noqa: F401,F403
+from .logic import *         # noqa: F401,F403
+from .linalg import *        # noqa: F401,F403
+from .activation import *    # noqa: F401,F403
+from .conv import *          # noqa: F401,F403
+from .norm_ops import *      # noqa: F401,F403
+from .loss import *          # noqa: F401,F403
+
+from . import _bind  # attaches Tensor operators/methods  # noqa: F401,E402

@@ -1,0 +1,126 @@
+"""Attach operators and tensor methods to Tensor.
+
+Analog of the reference's monkey-patching of VarBase with math methods
+(reference: python/paddle/fluid/dygraph/math_op_patch.py and
+varbase_patch_methods.py): the Tensor class stays minimal and the op
+library decorates it at import time, avoiding an import cycle.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+from . import (activation, creation, linalg, logic, loss, manipulation, math,
+               norm_ops, reduction)
+
+_BINARY = {
+    "__add__": math.add, "__radd__": lambda x, y: math.add(y, x),
+    "__sub__": math.subtract, "__rsub__": lambda x, y: math.subtract(y, x),
+    "__mul__": math.multiply, "__rmul__": lambda x, y: math.multiply(y, x),
+    "__truediv__": math.divide, "__rtruediv__": lambda x, y: math.divide(y, x),
+    "__floordiv__": math.floor_divide,
+    "__rfloordiv__": lambda x, y: math.floor_divide(y, x),
+    "__mod__": math.remainder, "__rmod__": lambda x, y: math.remainder(y, x),
+    "__pow__": math.pow, "__rpow__": lambda x, y: math.pow(y, x),
+    "__matmul__": linalg.matmul, "__rmatmul__": lambda x, y: linalg.matmul(y, x),
+    "__eq__": logic.equal, "__ne__": logic.not_equal,
+    "__lt__": logic.less_than, "__le__": logic.less_equal,
+    "__gt__": logic.greater_than, "__ge__": logic.greater_equal,
+    "__and__": logic.bitwise_and, "__or__": logic.bitwise_or,
+    "__xor__": logic.bitwise_xor,
+}
+
+for name, fn in _BINARY.items():
+    def make(fn):
+        def method(self, other):
+            return fn(self, other)
+        return method
+    setattr(Tensor, name, make(fn))
+
+Tensor.__neg__ = lambda self: math.neg(self)
+Tensor.__abs__ = lambda self: math.abs(self)
+Tensor.__invert__ = lambda self: logic.bitwise_not(self)
+
+_METHODS = dict(
+    # math
+    add=math.add, subtract=math.subtract, multiply=math.multiply,
+    divide=math.divide, pow=math.pow, abs=math.abs, sign=math.sign,
+    exp=math.exp, log=math.log, log2=math.log2, log10=math.log10,
+    log1p=math.log1p, sqrt=math.sqrt, rsqrt=math.rsqrt, square=math.square,
+    reciprocal=math.reciprocal, sin=math.sin, cos=math.cos, tan=math.tan,
+    tanh=math.tanh, floor=math.floor, ceil=math.ceil, round=math.round,
+    clip=math.clip, cumsum=math.cumsum, cumprod=math.cumprod,
+    scale=math.scale, neg=math.neg, erf=math.erf, lerp=math.lerp,
+    maximum=math.maximum, minimum=math.minimum, remainder=math.remainder,
+    mod=math.remainder, floor_divide=math.floor_divide, kron=math.kron,
+    trunc=math.trunc, frac=math.frac, conj=math.conj, real=math.real,
+    imag=math.imag, angle=math.angle, digamma=math.digamma,
+    lgamma=math.lgamma, logit=math.logit, isnan=logic.isnan,
+    isinf=logic.isinf, isfinite=logic.isfinite,
+    # reduction
+    sum=reduction.sum, mean=reduction.mean, max=reduction.max,
+    min=reduction.min, prod=reduction.prod, std=reduction.std,
+    var=reduction.var, argmax=reduction.argmax, argmin=reduction.argmin,
+    all=reduction.all, any=reduction.any, logsumexp=reduction.logsumexp,
+    amax=reduction.amax, amin=reduction.amin, median=reduction.median,
+    quantile=reduction.quantile, count_nonzero=reduction.count_nonzero,
+    kthvalue=reduction.kthvalue, nansum=reduction.nansum,
+    nanmean=reduction.nanmean,
+    # manipulation
+    reshape=manipulation.reshape, transpose=manipulation.transpose,
+    squeeze=manipulation.squeeze, unsqueeze=manipulation.unsqueeze,
+    flatten=manipulation.flatten, expand=manipulation.expand,
+    expand_as=manipulation.expand_as, broadcast_to=manipulation.broadcast_to,
+    tile=manipulation.tile, flip=manipulation.flip, roll=manipulation.roll,
+    gather=manipulation.gather, gather_nd=manipulation.gather_nd,
+    index_select=manipulation.index_select, scatter=manipulation.scatter,
+    scatter_nd_add=manipulation.scatter_nd_add, split=manipulation.split,
+    chunk=manipulation.chunk, unbind=manipulation.unbind,
+    topk=manipulation.topk, sort=manipulation.sort,
+    argsort=manipulation.argsort, unique=manipulation.unique,
+    masked_select=manipulation.masked_select,
+    masked_fill=manipulation.masked_fill, tril=manipulation._tril,
+    triu=manipulation._triu, diagonal=manipulation.diagonal,
+    repeat_interleave=manipulation.repeat_interleave,
+    take_along_axis=manipulation.take_along_axis,
+    put_along_axis=manipulation.put_along_axis, where=manipulation.where,
+    moveaxis=manipulation.moveaxis, swapaxes=manipulation.swapaxes,
+    nonzero=manipulation.nonzero, bincount=manipulation.bincount,
+    # linalg
+    matmul=linalg.matmul, dot=linalg.dot, bmm=linalg.bmm, mv=linalg.mv,
+    norm=linalg.norm, dist=linalg.dist, cholesky=linalg.cholesky,
+    inverse=linalg.inverse, t=manipulation.t, outer=linalg.outer,
+    inner=linalg.inner, cross=linalg.cross,
+    # logic
+    equal=logic.equal, not_equal=logic.not_equal,
+    greater_than=logic.greater_than, greater_equal=logic.greater_equal,
+    less_than=logic.less_than, less_equal=logic.less_equal,
+    logical_and=logic.logical_and, logical_or=logic.logical_or,
+    logical_not=logic.logical_not, logical_xor=logic.logical_xor,
+    isclose=logic.isclose, allclose=logic.allclose, equal_all=logic.equal_all,
+    bitwise_and=logic.bitwise_and, bitwise_or=logic.bitwise_or,
+    bitwise_xor=logic.bitwise_xor, bitwise_not=logic.bitwise_not,
+    # activation-ish tensor methods
+    sigmoid=activation.sigmoid, softmax=activation.softmax,
+    # creation-likes
+    zeros_like=creation.zeros_like, ones_like=creation.ones_like,
+    full_like=creation.full_like,
+)
+
+for name, fn in _METHODS.items():
+    def make_m(fn):
+        def method(self, *args, **kwargs):
+            return fn(self, *args, **kwargs)
+        return method
+    if not hasattr(Tensor, name):
+        setattr(Tensor, name, make_m(fn))
+
+
+def _numel(self):
+    from ._dispatch import wrap
+    import jax.numpy as jnp
+    return wrap(jnp.asarray(self.size, jnp.int64))
+
+
+Tensor.numel = _numel
+
+# T property (paddle's .T)
+Tensor.T = property(lambda self: manipulation.t(self))

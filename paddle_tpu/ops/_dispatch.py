@@ -1,0 +1,45 @@
+"""Op definition layer.
+
+TPU-native analog of the reference's op registry + kernel dispatch
+(reference: paddle/fluid/framework/op_registry.h:256 REGISTER_OPERATOR;
+framework/operator.cc:1166 ChooseKernel). Design delta (SURVEY.md §7.1):
+there is exactly ONE kernel per op — a pure jnp/lax function — and XLA's
+layout assignment replaces ChooseKernel/PrepareData. `defop` lifts the raw
+function to Tensor-land through the autograd recorder (core/tape.py), so the
+same definition serves eager dygraph, jit-compiled steps, and the static
+Program interpreter. OP_REGISTRY is the OpInfoMap equivalent consulted by
+paddle_tpu.static when pretty-printing programs.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..core.tape import record_op
+from ..core.tensor import Tensor
+
+OP_REGISTRY = {}
+
+
+def defop(raw_fn=None, *, name=None):
+    """Lift a raw jnp function into a Tensor-level differentiable op."""
+    def deco(f):
+        opname = name or f.__name__.lstrip("_")
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            return record_op(f, args, kwargs, opname)
+
+        wrapper.raw = f
+        wrapper.op_name = opname
+        OP_REGISTRY[opname] = wrapper
+        return wrapper
+
+    return deco(raw_fn) if raw_fn is not None else deco
+
+
+def unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def wrap(v, stop_gradient=True):
+    return Tensor(v, stop_gradient=stop_gradient, _internal=True)

@@ -1,0 +1,171 @@
+"""Activation functional ops.
+
+Parity targets: reference operators/activation_op.cc (~40 activations),
+softmax_op.cc (cudnn path), gelu_op.cc, prelu_op.cc.
+XLA fuses these into neighboring matmuls/convs (VPU work), which is the
+TPU analog of the reference's fused_ops/fusion_group CUDA codegen.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._dispatch import defop
+
+
+@defop
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@defop
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+@defop
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@defop
+def prelu(x, weight):
+    return jnp.where(x >= 0, x, weight * x)
+
+
+@defop
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@defop
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@defop
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@defop
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@defop
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@defop
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@defop
+def hardswish(x):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@defop
+def hardtanh(x, min=-1.0, max=1.0):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@defop
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@defop
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@defop
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@defop
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+@defop
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@defop
+def softplus(x, beta=1.0, threshold=20.0):
+    bx = beta * x
+    # clamp the exp argument so the unselected branch can't produce inf,
+    # whose vjp would poison the gradient with NaN
+    safe = jnp.log1p(jnp.exp(jnp.minimum(bx, threshold))) / beta
+    return jnp.where(bx > threshold, x, safe)
+
+
+@defop
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@defop
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@defop
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@defop
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@defop
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from ..core import rng as _rng
+    g = jax.random.gumbel(_rng.next_key(), x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        y = y_hard + (y - jax.lax.stop_gradient(y))  # straight-through
+    return y
+
+
+@defop
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(jnp.reshape(x, new_shape), axis=axis + 1)
+
+
+@defop
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+@defop
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@defop
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    denom = jnp.maximum(jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True),
+                        epsilon)
+    return x / denom

@@ -1,0 +1,281 @@
+"""Convolution / pooling / vision ops.
+
+Parity targets: reference operators/conv_op.cc + conv_cudnn_op.cu,
+conv_transpose_op.cc, pool_op.cc, interpolate_v2_op.cc, pixel_shuffle,
+grid_sampler, unfold. Convs are lowered to `lax.conv_general_dilated`,
+which XLA tiles onto the MXU directly (the analog of the reference's
+cuDNN algo search, operators/conv_cudnn_op.cu).
+Layouts: paddle default is NCHW; we pass the layout straight to XLA and let
+layout assignment pick the TPU-native tiling rather than transposing by hand.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ._dispatch import defop
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, k, stride, dilation, nd):
+    """paddle padding spec -> lax padding list."""
+    if isinstance(padding, str):
+        p = padding.upper()
+        return p  # 'SAME' / 'VALID'
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nd)]
+    raise ValueError(f"bad padding {padding}")
+
+
+@defop
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    nd = 2
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    pad = _conv_padding(padding, None, stride, dilation, nd)
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    if x.dtype == jnp.bfloat16:
+        out = out.astype(jnp.bfloat16)
+    if bias is not None:
+        bshape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + jnp.reshape(bias, bshape)
+    return out
+
+
+@defop
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    stride = _pair(stride, 1)
+    dilation = _pair(dilation, 1)
+    pad = _conv_padding(padding, None, stride, dilation, 1)
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "HIO", "NHC"))
+    out = lax.conv_general_dilated(x, weight, stride, pad, rhs_dilation=dilation,
+                                   dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        bshape = (1, -1, 1) if data_format == "NCL" else (1, 1, -1)
+        out = out + jnp.reshape(bias, bshape)
+    return out
+
+
+@defop
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    pad = _conv_padding(padding, None, stride, dilation, 3)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(x, weight, stride, pad, rhs_dilation=dilation,
+                                   dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1, 1))
+    return out
+
+
+@defop
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW"):
+    nd = 2
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    opad = _pair(output_padding, nd)
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pad = _conv_padding(padding, None, stride, dilation, nd)
+    # weight layout IOHW (paddle conv_transpose), flip spatial, swap I/O
+    k = weight.shape[2:]
+    lax_pad = [(dilation[i] * (k[i] - 1) - pad[i][0],
+                dilation[i] * (k[i] - 1) - pad[i][1] + opad[i]) for i in range(nd)]
+    w = jnp.flip(weight, axis=(2, 3))
+    w = jnp.swapaxes(w, 0, 1)  # -> OIHW with O=out_channels*groups handling below
+    if groups > 1:
+        ci_g = weight.shape[0] // groups
+        co_g = weight.shape[1]
+        w = jnp.reshape(jnp.swapaxes(jnp.reshape(
+            weight, (groups, ci_g, co_g) + k), 1, 2), (groups * co_g, ci_g) + k)
+        w = jnp.flip(w, axis=(2, 3))
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(x, w, window_strides=(1, 1), padding=lax_pad,
+                                   lhs_dilation=stride, rhs_dilation=dilation,
+                                   dimension_numbers=dn,
+                                   feature_group_count=groups)
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1))
+    return out
+
+
+
+def _ceil_adjust(pads, shape, window, strides, ceil_mode):
+    """Extend high-side padding so floor-division matches paddle ceil_mode."""
+    if not ceil_mode:
+        return pads
+    if isinstance(pads, str):
+        raise NotImplementedError("ceil_mode with string padding")
+    out = []
+    for d, (lo, hi) in enumerate(pads):
+        L, k, s = shape[d], window[d], strides[d]
+        eff = L + lo + hi
+        out_ceil = -((eff - k) // -s) + 1
+        extra = (out_ceil - 1) * s + k - eff
+        out.append((lo, hi + max(extra, 0)))
+    return out
+
+
+@defop
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    pad = _conv_padding(padding, k, s, (1, 1), 2)
+    if data_format == "NCHW":
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else pad)
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)]
+    if isinstance(pad, str):
+        pads = pad
+    pads = _ceil_adjust(pads, x.shape, window, strides, ceil_mode)
+    # -inf init is required for XLA's reduce_window_max autodiff rule
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, neg, lax.max, window, strides, pads)
+
+
+@defop
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCHW"):
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    pad = _conv_padding(padding, k, s, (1, 1), 2)
+    if data_format == "NCHW":
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else pad)
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = [(0, 0)] + (pad if isinstance(pad, list) else pad) + [(0, 0)]
+    if isinstance(pad, str):
+        pads = pad
+    pads = _ceil_adjust(pads, x.shape, window, strides, ceil_mode)
+    summed = lax.reduce_window(x, jnp.array(0, x.dtype), lax.add, window,
+                               strides, pads)
+    if exclusive and not isinstance(pads, str):
+        counts = lax.reduce_window(jnp.ones_like(x), jnp.array(0, x.dtype),
+                                   lax.add, window, strides, pads)
+        return summed / counts
+    import numpy as np
+    return summed / np.prod(k)
+
+
+@defop
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    k = _pair(kernel_size, 1)
+    s = _pair(stride, 1) if stride is not None else k
+    pad = _conv_padding(padding, k, s, (1,), 1)
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    pads = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + pad
+    pads = _ceil_adjust(pads, x.shape, (1, 1) + k, (1, 1) + s, ceil_mode)
+    return lax.reduce_window(x, neg, lax.max, (1, 1) + k, (1, 1) + s, pads)
+
+
+@defop
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    out_hw = _pair(output_size)
+    if data_format != "NCHW":
+        raise NotImplementedError
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    if h % oh == 0 and w % ow == 0:
+        x4 = jnp.reshape(x, (n, c, oh, h // oh, ow, w // ow))
+        return jnp.mean(x4, axis=(3, 5))
+    # general case: integral-image style via cumulative sums
+    cs = jnp.cumsum(jnp.cumsum(x, axis=2), axis=3)
+    cs = jnp.pad(cs, [(0, 0), (0, 0), (1, 0), (1, 0)])
+    import numpy as np
+    hs = np.floor(np.arange(oh) * h / oh).astype(int)
+    he = np.ceil((np.arange(oh) + 1) * h / oh).astype(int)
+    ws = np.floor(np.arange(ow) * w / ow).astype(int)
+    we = np.ceil((np.arange(ow) + 1) * w / ow).astype(int)
+    area = (he - hs)[:, None] * (we - ws)[None, :]
+    out = (cs[:, :, he][:, :, :, we] - cs[:, :, hs][:, :, :, we]
+           - cs[:, :, he][:, :, :, ws] + cs[:, :, hs][:, :, :, ws])
+    return out / jnp.asarray(area, x.dtype)
+
+
+@defop
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    out_hw = _pair(output_size)
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    if h % oh or w % ow:
+        raise NotImplementedError("adaptive_max_pool2d needs divisible sizes")
+    x4 = jnp.reshape(x, (n, c, oh, h // oh, ow, w // ow))
+    return jnp.max(x4, axis=(3, 5))
+
+
+@defop
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW"):
+    n, c, h, w = x.shape
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor,) * 2
+        size = (int(h * sf[0]), int(w * sf[1]))
+    size = tuple(int(s) for s in size)
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "area": "linear"}[mode]
+    xt = jnp.transpose(x, (0, 2, 3, 1))
+    out = jax.image.resize(xt, (n,) + size + (c,), method=method)
+    return jnp.transpose(out, (0, 3, 1, 2)).astype(x.dtype)
+
+
+@defop
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    x = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return jnp.reshape(x, (n, c // (r * r), h * r, w * r))
+
+
+@defop
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+    n, c, h, w = x.shape
+    x = jnp.pad(x, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+    oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+    ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    patches = []
+    for i in range(k[0]):
+        for j in range(k[1]):
+            patches.append(x[:, :, i * d[0]: i * d[0] + oh * s[0]: s[0],
+                             j * d[1]: j * d[1] + ow * s[1]: s[1]])
+    out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+    return jnp.reshape(out, (n, c * k[0] * k[1], oh * ow))

@@ -1,0 +1,178 @@
+"""Tensor creation ops.
+
+Parity targets: reference operators/fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc, range_op.cc, linspace_op.cc, eye_op.cc,
+fill_any_like_op.cc, randint / randperm / bernoulli / multinomial ops and
+python/paddle/tensor/creation.py. Random ops draw from the global functional
+PRNG chain (core/rng.py) instead of per-device curand states.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._dispatch import defop, unwrap, wrap
+from ..core import rng as _rng
+from ..core.dtype import to_jax_dtype
+from ..core.tensor import Tensor
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(unwrap(s)) if not isinstance(s, int) else s for s in shape)
+
+
+def zeros(shape, dtype="float32"):
+    return wrap(jnp.zeros(_shape(shape), to_jax_dtype(dtype)))
+
+
+def ones(shape, dtype="float32"):
+    return wrap(jnp.ones(_shape(shape), to_jax_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype="float32"):
+    return wrap(jnp.full(_shape(shape), unwrap(fill_value), to_jax_dtype(dtype)))
+
+
+@defop
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=to_jax_dtype(dtype))
+
+
+@defop
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=to_jax_dtype(dtype))
+
+
+@defop
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=to_jax_dtype(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    return wrap(jnp.arange(start, end, step, dtype=to_jax_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None):
+    return wrap(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                             dtype=to_jax_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return wrap(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                             base=base, dtype=to_jax_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return wrap(jnp.eye(num_rows, num_columns, dtype=to_jax_dtype(dtype)))
+
+
+def empty(shape, dtype="float32"):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype=dtype)
+
+
+def diag(x, offset=0, padding_value=0):
+    v = unwrap(x)
+    if v.ndim == 1 and padding_value != 0:
+        n = v.shape[0] + abs(offset)
+        out = jnp.full((n, n), padding_value, v.dtype)
+        return wrap(out + jnp.diag(v, offset)
+                    - jnp.diag(jnp.full(v.shape, padding_value, v.dtype), offset))
+    return wrap(jnp.diag(v, offset))
+
+
+def diagflat(x, offset=0):
+    return wrap(jnp.diagflat(unwrap(x), offset))
+
+
+def tril(x, diagonal=0):
+    from .manipulation import _tril
+    return _tril(x, diagonal=diagonal)
+
+
+def triu(x, diagonal=0):
+    from .manipulation import _triu
+    return _triu(x, diagonal=diagonal)
+
+
+def meshgrid(*args):
+    arrs = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return tuple(wrap(m) for m in jnp.meshgrid(*arrs, indexing="ij"))
+
+
+# -- random -----------------------------------------------------------------
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0):  # noqa: A002
+    key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+    return wrap(jax.random.uniform(key, _shape(shape), to_jax_dtype(dtype),
+                                   minval=unwrap(min), maxval=unwrap(max)))
+
+
+def rand(shape, dtype="float32"):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    if shape is None:
+        shape = ()
+    key = _rng.next_key()
+    return wrap(jax.random.normal(key, _shape(shape)) * unwrap(std) + unwrap(mean))
+
+
+def randn(shape, dtype="float32"):
+    key = _rng.next_key()
+    return wrap(jax.random.normal(key, _shape(shape), to_jax_dtype(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    key = _rng.next_key()
+    return wrap(jax.random.randint(key, _shape(shape), low, high,
+                                   to_jax_dtype(dtype)))
+
+
+def randperm(n, dtype="int64"):
+    key = _rng.next_key()
+    return wrap(jax.random.permutation(key, n).astype(to_jax_dtype(dtype)))
+
+
+def bernoulli(x):
+    key = _rng.next_key()
+    v = unwrap(x)
+    return wrap(jax.random.bernoulli(key, v).astype(v.dtype))
+
+
+def poisson(x):
+    key = _rng.next_key()
+    v = unwrap(x)
+    return wrap(jax.random.poisson(key, v).astype(v.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    key = _rng.next_key()
+    v = unwrap(x)
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1,
+                                     shape=(num_samples,) + v.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return wrap(out.astype(jnp.int64))
+
+
+def standard_normal(shape, dtype="float32"):
+    return randn(shape, dtype)

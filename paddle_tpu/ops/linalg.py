@@ -1,0 +1,196 @@
+"""Linear algebra ops.
+
+Parity targets: reference operators/matmul_v2_op.cc (+ math/blas.h GEMM
+dispatch), mul_op.cc, dot_op.cc, bmm_op.cc, p_norm_op.cc, cholesky_op.cc,
+svd, inverse_op.cc, triangular ops, and python/paddle/tensor/linalg.py.
+
+TPU note: matmuls are the MXU hot path. `FLAGS_use_bf16_matmul` keeps
+operands in bf16 with f32 accumulation via `preferred_element_type`
+(SURVEY.md §7 "MXU" guidance) — the analog of the reference's cuBLAS
+TF32/FP16 tensor-core paths.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._dispatch import defop
+
+
+@defop
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    from ..core.flags import flag
+    pref = None
+    if (flag("FLAGS_use_bf16_matmul")
+            and x.dtype == jnp.bfloat16 and y.dtype == jnp.bfloat16):
+        pref = jnp.float32  # accumulate in f32 on the MXU
+    out = jnp.matmul(x, y, preferred_element_type=pref)
+    if pref is not None:
+        out = out.astype(jnp.bfloat16)
+    return out
+
+
+@defop
+def dot(x, y):
+    # paddle.dot: 1-d/2-d innermost product, batched on leading dim
+    return jnp.sum(x * y, axis=-1)
+
+
+@defop
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@defop
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@defop
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@defop
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@defop
+def cross(x, y, axis=None):
+    return jnp.cross(x, y, axis=-1 if axis is None else axis)
+
+
+@defop
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=tuple(axis) if isinstance(axis, (list, tuple)) else axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=ax, keepdims=keepdim),
+                     1.0 / p)
+
+
+@defop
+def p_norm(x, porder=2.0, axis=-1, keepdim=False, epsilon=1e-12):
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), porder), axis=axis,
+                             keepdims=keepdim) + epsilon, 1.0 / porder)
+
+
+@defop
+def dist(x, y, p=2.0):
+    d = jnp.abs(x - y)
+    if p == 0:
+        return jnp.sum((d != 0).astype(x.dtype))
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == float("-inf"):
+        return jnp.min(d)
+    return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+
+
+@defop
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@defop
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@defop
+def pinv(x, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+@defop
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@defop
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@defop
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return sign, logdet
+
+
+@defop
+def svd(x, full_matrices=False):
+    return tuple(jnp.linalg.svd(x, full_matrices=full_matrices))
+
+
+@defop
+def qr(x, mode="reduced"):
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+@defop
+def eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+@defop
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@defop
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@defop
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@defop
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@defop
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@defop
+def multi_dot(*xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+@defop
+def histogram(x, bins=100, min=0, max=0):  # noqa: A002
+    if min == 0 and max == 0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+    return hist
+
+
+@defop
+def einsum(equation, *operands):
+    return jnp.einsum(equation, *operands)

@@ -1,0 +1,117 @@
+"""Comparison / logical / predicate ops.
+
+Parity targets: reference operators/controlflow/compare_op.cc,
+logical_op.cc, isfinite_v2_op.cc and python/paddle/tensor/logic.py.
+All outputs are bool and never carry gradient.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._dispatch import defop
+
+
+@defop
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@defop
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@defop
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@defop
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@defop
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@defop
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@defop
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@defop
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@defop
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@defop
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@defop
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@defop
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@defop
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@defop
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@defop
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@defop
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@defop
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@defop
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    from ._dispatch import unwrap, wrap
+    return wrap(jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                             equal_nan=equal_nan))
+
+
+def equal_all(x, y):
+    from ._dispatch import unwrap, wrap
+    return wrap(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+@defop
+def is_empty(x):
+    return jnp.asarray(x.size == 0)

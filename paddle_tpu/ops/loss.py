@@ -1,0 +1,192 @@
+"""Loss functional ops.
+
+Parity targets: reference operators/softmax_with_cross_entropy_op.cc,
+cross_entropy_op.cc, mean/squared_l2_distance, bce_loss_op.cc,
+sigmoid_cross_entropy_with_logits_op.cc, huber_loss_op.cc, kldiv_loss_op.cc,
+smooth_l1_loss_op.cc, margin_rank_loss, hinge, nll via gather, mse, ctc
+(warpctc — deferred), label_smooth_op.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._dispatch import defop
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@defop
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        valid = lbl != ignore_index
+        safe_lbl = jnp.where(valid, lbl, 0)  # avoid OOB gather on sentinel
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe_lbl, axis).astype(jnp.int32), axis=axis)
+        loss = jnp.where(jnp.expand_dims(valid, axis), -picked, 0.0)
+    if return_softmax:
+        return loss, jax.nn.softmax(logits, axis=axis)
+    return loss
+
+
+@defop
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    if use_softmax:
+        logp = jax.nn.log_softmax(input, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(input, 1e-30))
+    n_classes = input.shape[axis]
+    if soft_label:
+        soft = label
+    else:
+        lbl = label
+        if lbl.ndim == input.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        soft = jax.nn.one_hot(lbl, n_classes, axis=axis, dtype=logp.dtype)
+    if label_smoothing > 0.0:
+        soft = soft * (1.0 - label_smoothing) + label_smoothing / n_classes
+    loss = -jnp.sum(soft * logp, axis=axis)
+    lbl1 = (jnp.squeeze(label, axis) if not soft_label and label.ndim == input.ndim
+            else label)
+    if weight is not None and not soft_label:
+        loss = loss * jnp.take(weight, jnp.where(lbl1 == ignore_index, 0, lbl1))
+    if not soft_label:
+        valid = (lbl1 != ignore_index)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return _reduce(loss, reduction)
+
+
+@defop
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):  # noqa: A002
+    picked = -jnp.take_along_axis(input, label[..., None].astype(jnp.int32),
+                                  axis=-1)[..., 0]
+    if weight is not None:
+        picked = picked * jnp.take(weight, label)
+    return _reduce(picked, reduction)
+
+
+@defop
+def mse_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@defop
+def l1_loss(input, label, reduction="mean"):  # noqa: A002
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@defop
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):  # noqa: A002
+    d = input - label
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@defop
+def huber_loss(input, label, delta=1.0):  # noqa: A002
+    d = input - label
+    ad = jnp.abs(d)
+    return jnp.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+
+
+@defop
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):  # noqa: A002
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps))
+             + (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@defop
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    neg_abs = -jnp.abs(logit)
+    loss = jnp.maximum(logit, 0.0) - logit * label + jnp.log1p(jnp.exp(neg_abs))
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = loss * log_w
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+sigmoid_cross_entropy_with_logits = binary_cross_entropy_with_logits
+
+
+@defop
+def kl_div(input, label, reduction="mean"):  # noqa: A002
+    # input is log-prob, label is prob (paddle semantics)
+    loss = label * (jnp.log(jnp.maximum(label, 1e-30)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@defop
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):  # noqa: A002
+    loss = jnp.maximum(-label * (input - other) + margin, 0.0)
+    return _reduce(loss, reduction)
+
+
+@defop
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):  # noqa: A002
+    loss = jnp.where(label == 1.0, input, jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+@defop
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@defop
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / n
+
+
+@defop
+def square_error_cost(input, label):  # noqa: A002
+    return jnp.square(input - label)
+
+
+@defop
+def log_loss(input, label, epsilon=1e-4):  # noqa: A002
+    return -(label * jnp.log(input + epsilon)
+             + (1 - label) * jnp.log(1 - input + epsilon))
+
+
+@defop
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, reduction="mean"):
+    dp = jnp.power(jnp.sum(jnp.power(jnp.abs(input - positive) + epsilon, p),
+                           axis=-1), 1.0 / p)
+    dn = jnp.power(jnp.sum(jnp.power(jnp.abs(input - negative) + epsilon, p),
+                           axis=-1), 1.0 / p)
+    return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)

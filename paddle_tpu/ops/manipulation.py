@@ -1,0 +1,427 @@
+"""Shape / indexing / rearrangement ops.
+
+Parity targets: reference operators/reshape_op.cc, transpose_op.cc,
+concat_op.cc, split_op.cc, squeeze_op.cc, unsqueeze_op.cc, stack_op.cc,
+gather(_nd)_op.cc, scatter_op.cc, slice_op.cc, strided_slice_op.cc,
+expand_v2_op.cc, tile_op.cc, flip_op.cc, roll_op.cc, pad3d/pad_op.cc,
+top_k_v2_op.cc, argsort_op.cc, unique_op.cc, where_op.cc, index_select_op.cc,
+set_value_op.cc and python/paddle/tensor/manipulation.py.
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+
+from ._dispatch import defop, unwrap, wrap
+from ..core.tensor import Tensor
+
+
+@defop
+def reshape(x, shape):
+    shape = tuple(int(s) for s in shape)
+    return jnp.reshape(x, shape)
+
+
+@defop
+def transpose(x, perm=None):
+    return jnp.transpose(x, axes=perm)
+
+
+@defop
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@defop
+def swapaxes(x, axis0, axis1):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@defop
+def t(x):
+    return x.T
+
+
+@defop(name="concat")
+def _concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _concat(*x, axis=axis)
+
+
+@defop(name="stack")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0):
+    return _stack(*x, axis=axis)
+
+
+@defop(name="split_op")
+def _split(x, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    idx = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        idx.append(acc)
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def split(x, num_or_sections, axis=0):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(num_or_sections, (list, tuple)):
+        total = x.shape[axis]
+        secs = [s if isinstance(s, int) else int(unwrap(s)) for s in num_or_sections]
+        known = builtins.sum(s for s in secs if s >= 0)
+        secs = [s if s >= 0 else total - known for s in secs]
+        return list(_split(x, secs, axis))
+    return list(_split(x, int(num_or_sections), axis))
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+@defop(name="unbind_op")
+def _unbind(x, axis):
+    n = x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis))
+
+
+def unbind(x, axis=0):
+    return list(_unbind(x, axis))
+
+
+@defop
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+@defop
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.expand_dims(x, tuple(axis))
+
+
+@defop
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return jnp.reshape(x, (1,))
+    s = start_axis % nd
+    e = stop_axis % nd
+    shape = x.shape[:s] + (-1,) + x.shape[e + 1:]
+    return jnp.reshape(x, shape)
+
+
+@defop
+def expand(x, shape):
+    shape = tuple(int(s) for s in shape)
+    # paddle semantics: -1 keeps the original dim
+    full = []
+    offset = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            full.append(x.shape[i - offset])
+        else:
+            full.append(s)
+    return jnp.broadcast_to(x, tuple(full))
+
+
+@defop
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@defop
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(int(s) for s in shape))
+
+
+@defop
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+@defop
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+@defop
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@defop
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+@defop
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@defop
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@defop
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@defop
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@defop
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@defop
+def put_along_axis(x, indices, values, axis):
+    return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+
+
+@defop
+def scatter(x, index, updates, overwrite=True):
+    # reference: operators/scatter_op.cc — row-wise scatter on axis 0
+    if overwrite:
+        return x.at[index].set(updates)
+    base = x.at[index].set(jnp.zeros_like(updates))
+    return base.at[index].add(updates)
+
+
+@defop
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    z = wrap(jnp.zeros(tuple(int(s) for s in shape), unwrap(updates).dtype))
+    return scatter_nd_add(z, index, updates)
+
+
+@defop
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return tuple(jnp.nonzero(condition))  # data-dependent; eager only
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    v = unwrap(x)
+    nz = jnp.nonzero(v)
+    if as_tuple:
+        return tuple(wrap(a[:, None]) for a in nz)
+    return wrap(jnp.stack(nz, axis=1))
+
+
+@defop
+def masked_select(x, mask):
+    return x[mask]  # data-dependent shape; eager only
+
+
+@defop
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+@defop
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    nd = x.ndim
+    pad = [int(p) for p in pad]
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle F.pad convention: first pair pads the LAST spatial dim
+        # (left,right,top,bottom,...), so reverse the pairs into dim order
+        n_spatial = len(pad) // 2
+        spatial = [(pad[2 * i], pad[2 * i + 1]) for i in range(n_spatial)]
+        spatial = spatial[::-1]
+        if data_format.upper().endswith("C"):  # NHWC / NLC / NDHWC
+            cfg = [(0, 0)] * (nd - n_spatial - 1) + spatial + [(0, 0)]
+        else:
+            cfg = [(0, 0)] * (nd - n_spatial) + spatial
+    if mode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@defop(name="topk_op")
+def _topk(x, k, axis, largest):
+    if axis not in (-1, x.ndim - 1):
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    vals, idx = jax.lax.top_k(xm if largest else -xm, k)
+    if not largest:
+        vals = -vals
+    if axis not in (-1, x.ndim - 1):
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):  # noqa: A002
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    return _topk(x, k, axis, largest)
+
+
+@defop
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@defop
+def argsort(x, axis=-1, descending=False):
+    idx = jnp.argsort(x, axis=axis)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.int64)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    v = unwrap(x)
+    out = jnp.unique(v, return_index=return_index, return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if isinstance(out, tuple):
+        return tuple(wrap(o) for o in out)
+    return wrap(out)
+
+
+@defop
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@defop
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@defop
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@defop
+def as_strided_slice(x, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):  # noqa: A001 - paddle API name
+    starts = [int(unwrap(s)) for s in starts]
+    ends = [int(unwrap(e)) for e in ends]
+    return as_strided_slice(x, axes, starts, ends, [1] * len(axes))
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    return as_strided_slice(x, [int(a) for a in axes], [int(unwrap(s)) for s in starts],
+                            [int(unwrap(e)) for e in ends], [int(unwrap(s)) for s in strides])
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_unwrap_index(i) for i in idx]
+    if isinstance(idx, builtins.slice):
+        return builtins.slice(_unwrap_index(idx.start), _unwrap_index(idx.stop),
+                              _unwrap_index(idx.step))
+    return idx
+
+
+@defop(name="getitem")
+def _getitem(x, idx):
+    return x[idx]
+
+
+def getitem(x, idx):
+    return _getitem(x, idx=_unwrap_index(idx))
+
+
+@defop(name="setitem")
+def _setitem(x, v, idx):
+    v = jnp.asarray(v, x.dtype) if not hasattr(v, "dtype") else v.astype(x.dtype)
+    return x.at[idx].set(v)
+
+
+def setitem(x, idx, value):
+    # reference: operators/set_value_op.cc; functional scatter + SSA rebind
+    value = value if isinstance(value, Tensor) else wrap(jnp.asarray(value))
+    return _setitem(x, value, idx=_unwrap_index(idx))
+
+
+@defop
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+@defop
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@defop
+def searchsorted(sorted_sequence, values, right=False):
+    side = "right" if right else "left"
+    return jnp.searchsorted(sorted_sequence, values, side=side).astype(jnp.int64)
+
+
+@defop
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+@defop
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@defop
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@defop
+def crop(x, shape, offsets):
+    idx = tuple(builtins.slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]

@@ -1,0 +1,382 @@
+"""Elementwise + scalar math ops.
+
+Parity targets: reference paddle/fluid/operators/elementwise/*,
+activation_op.cc (non-nn parts), scale_op.cc, clip_op.cc, cumsum_op.cc,
+matmul_v2_op.cc (linalg half lives in linalg.py). One jnp kernel per op;
+broadcasting follows numpy rules (the reference's axis-based broadcast is
+subsumed).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._dispatch import defop
+from ..core.dtype import to_jax_dtype
+
+
+@defop
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@defop
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@defop
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@defop
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@defop
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@defop
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+
+
+@defop
+def pow(x, y):  # noqa: A001 - paddle API name
+    return jnp.power(x, y)
+
+
+@defop
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@defop
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@defop
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@defop
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@defop
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    # reference: operators/scale_op.cc
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+@defop
+def neg(x):
+    return jnp.negative(x)
+
+
+@defop
+def abs(x):  # noqa: A001
+    return jnp.abs(x)
+
+
+@defop
+def sign(x):
+    return jnp.sign(x)
+
+
+@defop
+def exp(x):
+    return jnp.exp(x)
+
+
+@defop
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@defop
+def log(x):
+    return jnp.log(x)
+
+
+@defop
+def log2(x):
+    return jnp.log2(x)
+
+
+@defop
+def log10(x):
+    return jnp.log10(x)
+
+
+@defop
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@defop
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@defop
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@defop
+def square(x):
+    return jnp.square(x)
+
+
+@defop
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@defop
+def sin(x):
+    return jnp.sin(x)
+
+
+@defop
+def cos(x):
+    return jnp.cos(x)
+
+
+@defop
+def tan(x):
+    return jnp.tan(x)
+
+
+@defop
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@defop
+def acos(x):
+    return jnp.arccos(x)
+
+
+@defop
+def atan(x):
+    return jnp.arctan(x)
+
+
+@defop
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@defop
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@defop
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@defop
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@defop
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@defop
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@defop
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@defop
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@defop
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@defop
+def floor(x):
+    return jnp.floor(x)
+
+
+@defop
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@defop
+def round(x):  # noqa: A001
+    return jnp.round(x)
+
+
+@defop
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@defop
+def clip(x, min=None, max=None):  # noqa: A002
+    return jnp.clip(x, min, max)
+
+
+@defop
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@defop
+def cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+@defop
+def cumprod(x, dim=None):
+    return jnp.cumprod(x, axis=dim)
+
+
+@defop
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = jnp.reshape(x, (-1,))
+        axis = 0
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+@defop
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@defop
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@defop
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@defop
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@defop
+def multiply_no_nan(x, y):
+    return jnp.where(y == 0, 0.0, x * y)
+
+
+@defop
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@defop
+def cast(x, dtype):
+    # reference: operators/cast_op.cc; float->float casts carry gradient
+    return x.astype(to_jax_dtype(dtype))
+
+
+@defop
+def increment(x, value=1.0):
+    return x + value
+
+
+@defop
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@defop
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@defop
+def angle(x):
+    return jnp.angle(x)
+
+
+@defop
+def conj(x):
+    return jnp.conj(x)
+
+
+@defop
+def real(x):
+    return jnp.real(x)
+
+
+@defop
+def imag(x):
+    return jnp.imag(x)
+
+
+@defop
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@defop
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@defop
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@defop
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@defop
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@defop
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@defop
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@defop
+def assign(x):
+    # reference: operators/assign_op.cc — identity/copy
+    return jnp.asarray(x)

@@ -1,0 +1,150 @@
+"""Normalization + dropout + embedding functional ops.
+
+Parity targets: reference operators/batch_norm_op.cc (+ sync_batch_norm_op.cu),
+layer_norm_op.cc, instance_norm_op.cc, group_norm_op.cc, dropout_op.cc,
+lookup_table_v2_op.cc.
+
+batch_norm is functional: running stats go in and come out as values; the
+nn.BatchNorm layer threads them through its buffers so the same op works in
+eager mode and inside a jitted/partitioned train step. sync_batch_norm's
+cross-device moment reduction (reference sync_batch_norm_op.cu) maps to a
+`psum` over the data-parallel mesh axis when inside shard_map.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._dispatch import defop
+from ..core import rng as _rng
+
+
+@defop
+def layer_norm(x, weight=None, bias=None, epsilon=1e-05, begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis % x.ndim, x.ndim)) \
+        if begin_norm_axis != -1 else (x.ndim - 1,)
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@defop
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", sync_axis=None):
+    """Returns (out, new_running_mean, new_running_var)."""
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = -1
+
+    if training:
+        mean = jnp.mean(x, axis=reduce_axes)
+        mean_sq = jnp.mean(jnp.square(x), axis=reduce_axes)
+        if sync_axis is not None:
+            # sync_batch_norm: average moments over the DP mesh axis
+            mean = jax.lax.pmean(mean, sync_axis)
+            mean_sq = jax.lax.pmean(mean_sq, sync_axis)
+        var = mean_sq - jnp.square(mean)
+        new_rm = momentum * running_mean + (1 - momentum) * mean
+        new_rv = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+
+    out = (x - jnp.reshape(mean, bshape)) * jax.lax.rsqrt(
+        jnp.reshape(var, bshape) + epsilon)
+    if weight is not None:
+        out = out * jnp.reshape(weight, bshape)
+    if bias is not None:
+        out = out + jnp.reshape(bias, bshape)
+    return out, new_rm, new_rv
+
+
+@defop
+def instance_norm(x, weight=None, bias=None, epsilon=1e-05):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = out * jnp.reshape(weight, shape)
+    if bias is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = out + jnp.reshape(bias, shape)
+    return out
+
+
+@defop
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-05,
+               data_format="NCHW"):
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xg = jnp.reshape(x, (n, num_groups, c // num_groups) + spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = jnp.reshape((xg - mean) * jax.lax.rsqrt(var + epsilon), x.shape)
+    if weight is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = out * jnp.reshape(weight, shape)
+    if bias is not None:
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = out + jnp.reshape(bias, shape)
+    return out
+
+
+@defop
+def rms_norm(x, weight=None, epsilon=1e-06):
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    out = x * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@defop(name="dropout_op")
+def _dropout(x, key, p, mode):
+    if mode == "upscale_in_train":
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    mask = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(mask, x, 0.0).astype(x.dtype)
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None):
+    if not training or p == 0.0:
+        return x if hasattr(x, "_value") else x
+    key = _rng.next_key()
+    return _dropout(x, key, p=float(p), mode=mode)
+
+
+@defop
+def embedding(weight, ids, padding_idx=None, sparse=False):
+    # reference: operators/lookup_table_v2_op.cc. sparse=True maps to the
+    # same dense gather on TPU: SelectedRows grads have no XLA analog, the
+    # gather's scatter-add transpose is already the efficient form.
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None].astype(out.dtype)
+        out = out * mask
+    return out
+
+
+@defop
+def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    c = x.shape[1]
+    half = size // 2
+    padded = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2))
+    acc = jnp.zeros_like(x)
+    for i in range(size):
+        acc = acc + padded[:, i:i + c]
+    return x / jnp.power(k + alpha * acc, beta)

@@ -1,0 +1,139 @@
+"""Reduction ops.
+
+Parity targets: reference operators/reduce_ops/* (reduce_sum, reduce_mean,
+reduce_max, reduce_min, reduce_prod, reduce_all, reduce_any, logsumexp),
+arg_max/arg_min_op.cc, mean_op.cc, sum_op.cc and
+python/paddle/tensor/math.py / stat.py reductions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._dispatch import defop
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@defop(name="sum")
+def sum(x, axis=None, dtype=None, keepdim=False):  # noqa: A001
+    out = jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@defop
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop(name="max")
+def max(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop(name="min")
+def min(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop
+def amax(x, axis=None, keepdim=False):
+    return jnp.amax(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop
+def amin(x, axis=None, keepdim=False):
+    return jnp.amin(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop
+def prod(x, axis=None, keepdim=False, dtype=None):
+    out = jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim)
+    return out.astype(dtype) if dtype is not None else out
+
+
+@defop
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=_norm_axis(axis), keepdims=keepdim)
+    return out.astype(jnp.int64 if dtype is None else dtype)
+
+
+@defop
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=_norm_axis(axis), keepdims=keepdim)
+    return out.astype(jnp.int64 if dtype is None else dtype)
+
+
+@defop(name="all")
+def all(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop(name="any")
+def any(x, axis=None, keepdim=False):  # noqa: A001
+    return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@defop
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@defop
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop
+def nansum(x, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@defop
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.sum((x != 0).astype(jnp.int64), axis=_norm_axis(axis),
+                   keepdims=keepdim)
+
+
+def mode(x, axis=-1, keepdim=False):
+    raise NotImplementedError("mode: planned")
+
+
+@defop
+def kthvalue(x, k, axis=-1, keepdim=False):
+    xs = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis)
+    val = jnp.take(xs, k - 1, axis=axis)
+    ind = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+        ind = jnp.expand_dims(ind, axis)
+    return val, ind.astype(jnp.int64)

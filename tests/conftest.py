@@ -1,0 +1,24 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of running distributed tests as multiple
+local processes on one host (reference:
+python/paddle/fluid/tests/unittests/test_dist_base.py:642) — here XLA's
+host-platform device-count spoofing gives us 8 "chips" in-process instead.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_seed():
+    import paddle_tpu
+    paddle_tpu.seed(1234)
+    yield

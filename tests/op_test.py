@@ -1,0 +1,74 @@
+"""Golden-op test harness.
+
+TPU-native analog of the reference's OpTest
+(reference: python/paddle/fluid/tests/unittests/op_test.py:232 —
+check_output_with_place at :1027, check_grad numeric-vs-analytic at :1329,
+get_numeric_gradient at :101). Each op is checked two ways:
+  1. forward against a numpy reference callable,
+  2. tape-analytic gradient against central finite differences.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(op_fn, np_fn, inputs, rtol=1e-5, atol=1e-6, **attrs):
+    tensors = [paddle.to_tensor(v) for v in inputs]
+    out = op_fn(*tensors, **attrs)
+    ref = np_fn(*inputs, **attrs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), r, rtol=rtol, atol=atol)
+
+
+def numeric_grad(op_fn, inputs, wrt, delta=1e-3, **attrs):
+    """Central finite differences of sum(op(x)) w.r.t. inputs[wrt]."""
+    base = [np.array(v, dtype="float64") for v in inputs]
+
+    def f(vals):
+        ts = [paddle.to_tensor(v.astype("float64")) for v in vals]
+        out = op_fn(*ts, **attrs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        return float(sum(o.numpy().astype("float64").sum() for o in outs
+                         if np.issubdtype(o.numpy().dtype, np.floating)))
+
+    x = base[wrt]
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        orig = x[idx]
+        x[idx] = orig + delta
+        fp = f(base)
+        x[idx] = orig - delta
+        fm = f(base)
+        x[idx] = orig
+        g[idx] = (fp - fm) / (2 * delta)
+        it.iternext()
+    return g
+
+
+def check_grad(op_fn, inputs, wrt=None, rtol=2e-3, atol=2e-4, delta=1e-3,
+               **attrs):
+    """Compare tape-analytic grads against finite differences (float64)."""
+    wrt = wrt if wrt is not None else list(range(len(inputs)))
+    tensors = [paddle.to_tensor(np.array(v, dtype="float64"),
+                                stop_gradient=False) for v in inputs]
+    out = op_fn(*tensors, **attrs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    loss = None
+    for o in outs:
+        if np.issubdtype(o.numpy().dtype, np.floating):
+            s = o.sum()
+            loss = s if loss is None else loss + s
+    loss.backward()
+    for i in wrt:
+        analytic = tensors[i].grad.numpy()
+        numeric = numeric_grad(op_fn, inputs, i, delta=delta, **attrs)
+        np.testing.assert_allclose(
+            analytic, numeric, rtol=rtol, atol=atol,
+            err_msg=f"grad mismatch for input {i} of "
+                    f"{getattr(op_fn, 'op_name', op_fn)}")

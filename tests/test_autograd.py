@@ -1,0 +1,188 @@
+"""Dygraph autograd engine tests.
+
+Models the reference's imperative tests
+(python/paddle/fluid/tests/unittests/test_imperative_basic.py and
+test_imperative_double_grad.py's first-order parts); gradients are checked
+against hand-derived closed forms (the OpTest numeric-gradient discipline
+lives in tests/test_op_grads.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.exp(x)
+    z = (y * 2.0).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2.0 * np.exp([1.0, 2.0]), rtol=1e-6)
+
+
+def test_grad_accumulation_multiple_uses():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x + x  # dy/dx = 2x + 1 = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_grad_accumulates_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach_cuts_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = (x * x).detach()
+    z = y * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])  # only through z = y*x
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_no_grad_decorator():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+
+    @paddle.no_grad()
+    def f(v):
+        return v * 3
+
+    assert f(x).stop_gradient
+
+
+def test_matmul_grad():
+    a = np.random.rand(3, 4).astype("float32")
+    b = np.random.rand(4, 5).astype("float32")
+    ta = paddle.to_tensor(a, stop_gradient=False)
+    tb = paddle.to_tensor(b, stop_gradient=False)
+    out = paddle.matmul(ta, tb).sum()
+    out.backward()
+    ones = np.ones((3, 5), dtype="float32")
+    np.testing.assert_allclose(ta.grad.numpy(), ones @ b.T, rtol=1e-5)
+    np.testing.assert_allclose(tb.grad.numpy(), a.T @ ones, rtol=1e-5)
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor(np.ones((3, 4), "float32"), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((4,), "float32"), stop_gradient=False)
+    ((x + b) * 2).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [6, 6, 6, 6])
+
+
+def test_softmax_ce_grad_matches_softmax_minus_onehot():
+    logits = np.array([[1.0, 2.0, 3.0]], dtype="float32")
+    t = paddle.to_tensor(logits, stop_gradient=False)
+    label = paddle.to_tensor(np.array([2], dtype="int64"))
+    loss = paddle.ops.cross_entropy(t, label)
+    loss.backward()
+    sm = np.exp(logits) / np.exp(logits).sum()
+    expected = sm - np.eye(3, dtype="float32")[2]
+    np.testing.assert_allclose(t.grad.numpy(), expected, rtol=1e-5, atol=1e-6)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert x.grad is None  # paddle.grad must not touch .grad
+
+
+def test_paddle_grad_unused():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    z = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        paddle.grad(y, [x, z])
+    y2 = x * 2
+    gx, gz = paddle.grad(y2, [x, z], allow_unused=True)
+    assert gz is None and np.allclose(gx.numpy(), [2.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_freed_graph_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_setitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2
+    y[0] = 0.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x[1:]
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 1.0])
+
+
+def test_multi_output_split_grad():
+    x = paddle.to_tensor(np.arange(4, dtype="float32"), stop_gradient=False)
+    a, b = paddle.split(x, 2)
+    (a.sum() * 2 + b.sum() * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2, 3, 3])
+
+
+def test_backward_non_scalar_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 30.0])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])

@@ -1,0 +1,201 @@
+"""Numeric-vs-analytic gradient checks across the op library.
+
+The reference runs this contract for all 700+ ops via OpTest.check_grad
+(op_test.py:1329); here a representative slab of every op family is swept.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(7)
+
+
+def u(*shape):
+    return rng.uniform(0.5, 1.5, shape).astype("float64")
+
+
+def s(*shape):
+    return rng.uniform(-1.0, 1.0, shape).astype("float64")
+
+
+ELEMENTWISE_UNARY = [
+    (paddle.ops.exp, u(3, 4)),
+    (paddle.ops.log, u(3, 4)),
+    (paddle.ops.sqrt, u(3, 4)),
+    (paddle.ops.rsqrt, u(3, 4)),
+    (paddle.ops.square, s(3, 4)),
+    (paddle.ops.tanh, s(3, 4)),
+    (paddle.ops.sin, s(3, 4)),
+    (paddle.ops.cos, s(3, 4)),
+    (paddle.ops.sigmoid, s(3, 4)),
+    (paddle.ops.erf, s(3, 4)),
+    (paddle.ops.log1p, u(3, 4)),
+    (paddle.ops.reciprocal, u(3, 4)),
+    (paddle.ops.softplus, s(3, 4)),
+    (paddle.ops.silu, s(3, 4)),
+    (paddle.ops.mish, s(3, 4)),
+]
+
+
+@pytest.mark.parametrize("op,x", ELEMENTWISE_UNARY,
+                         ids=[op.op_name for op, _ in ELEMENTWISE_UNARY])
+def test_unary_grad(op, x):
+    check_grad(op, [x])
+
+
+BINARY = [
+    (paddle.ops.add, s(3, 4), s(3, 4)),
+    (paddle.ops.subtract, s(3, 4), s(3, 4)),
+    (paddle.ops.multiply, s(3, 4), s(3, 4)),
+    (paddle.ops.divide, s(3, 4), u(3, 4)),
+    (paddle.ops.maximum, s(3, 4), s(3, 4)),
+    (paddle.ops.minimum, s(3, 4), s(3, 4)),
+    (paddle.ops.matmul, s(3, 4), s(4, 5)),
+    (paddle.ops.atan2, u(3, 3), u(3, 3)),
+]
+
+
+@pytest.mark.parametrize("op,x,y", BINARY, ids=[op.op_name for op, _, _ in BINARY])
+def test_binary_grad(op, x, y):
+    check_grad(op, [x, y])
+
+
+def test_broadcast_binary_grad():
+    check_grad(paddle.ops.add, [s(3, 4), s(4)])
+    check_grad(paddle.ops.multiply, [s(2, 1, 4), s(3, 1)])
+
+
+REDUCTIONS = [
+    (paddle.ops.sum, dict()),
+    (paddle.ops.mean, dict()),
+    (paddle.ops.sum, dict(axis=1)),
+    (paddle.ops.mean, dict(axis=0, keepdim=True)),
+    (paddle.ops.logsumexp, dict()),
+    (paddle.ops.prod, dict(axis=1)),
+]
+
+
+@pytest.mark.parametrize("op,attrs", REDUCTIONS)
+def test_reduction_grad(op, attrs):
+    check_grad(op, [u(3, 4)], **attrs)
+
+
+def test_max_min_grad():
+    x = s(3, 4)
+    check_grad(paddle.ops.max, [x])
+    check_grad(paddle.ops.min, [x], rtol=5e-3)
+
+
+MANIP = [
+    (paddle.ops.reshape, dict(shape=(4, 3))),
+    (paddle.ops.transpose, dict(perm=(1, 0))),
+    (paddle.ops.flatten, dict()),
+    (paddle.ops.squeeze, dict()),
+]
+
+
+@pytest.mark.parametrize("op,attrs", MANIP)
+def test_manip_grad(op, attrs):
+    check_grad(op, [s(3, 4)], **attrs)
+
+
+def test_concat_grad():
+    check_grad(lambda a, b: paddle.concat([a, b], axis=1), [s(2, 3), s(2, 4)])
+
+
+def test_activation_outputs():
+    x = s(4, 5)
+    check_output(paddle.ops.relu, lambda v: np.maximum(v, 0), [x])
+    check_output(paddle.ops.softmax,
+                 lambda v: np.exp(v) / np.exp(v).sum(-1, keepdims=True), [x],
+                 rtol=1e-4)
+    check_output(paddle.ops.sigmoid, lambda v: 1 / (1 + np.exp(-v)), [x])
+
+
+def test_layer_norm_grad():
+    check_grad(lambda x, w, b: paddle.ops.layer_norm(x, w, b),
+               [s(4, 8), u(8), s(8)], rtol=5e-3, atol=5e-4)
+
+
+def test_softmax_grad():
+    check_grad(paddle.ops.softmax, [s(3, 5)])
+
+
+def test_cross_entropy_grad():
+    logits = s(4, 5)
+    label = np.array([0, 2, 4, 1])
+
+    def op(x):
+        return paddle.ops.cross_entropy(x, paddle.to_tensor(label))
+
+    check_grad(op, [logits])
+
+
+def test_conv2d_forward_matches_naive():
+    x = s(1, 2, 5, 5).astype("float32")
+    w = s(3, 2, 3, 3).astype("float32")
+    out = paddle.ops.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                            stride=1, padding=1)
+    # naive correlation
+    xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+    ref = np.zeros((1, 3, 5, 5), dtype="float64")
+    for o in range(3):
+        for i in range(2):
+            for r in range(5):
+                for c in range(5):
+                    ref[0, o, r, c] += (xp[0, i, r:r + 3, c:c + 3] * w[o, i]).sum()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_grad():
+    check_grad(lambda x, w: paddle.ops.conv2d(x, w, stride=1, padding=1),
+               [s(1, 2, 4, 4), s(2, 2, 3, 3)], rtol=5e-3, atol=5e-4)
+
+
+def test_pool_grads():
+    check_grad(lambda x: paddle.ops.avg_pool2d(x, 2), [s(1, 2, 4, 4)])
+    check_grad(lambda x: paddle.ops.max_pool2d(x, 2), [u(1, 2, 4, 4) + np.arange(16).reshape(1, 1, 4, 4)])
+
+
+def test_batch_norm_train_output():
+    x = s(4, 3, 2, 2).astype("float32")
+    rm = np.zeros(3, "float32")
+    rv = np.ones(3, "float32")
+    out, nrm, nrv = paddle.ops.batch_norm(
+        paddle.to_tensor(x), paddle.to_tensor(rm), paddle.to_tensor(rv),
+        training=True)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    ref = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(nrm.numpy(), 0.1 * mean, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_grad():
+    w = s(10, 4)
+    ids = np.array([1, 3, 3, 7])
+
+    def op(weight):
+        return paddle.ops.embedding(weight, paddle.to_tensor(ids))
+
+    check_grad(op, [w])
+
+
+def test_gather_grad():
+    idx = np.array([2, 0, 1])
+
+    def op(x):
+        return paddle.ops.gather(x, paddle.to_tensor(idx))
+
+    check_grad(op, [s(4, 3)])
+
+
+def test_losses_forward():
+    x = u(4, 3)
+    y = u(4, 3)
+    check_output(paddle.ops.mse_loss, lambda a, b: ((a - b) ** 2).mean(), [x, y])
+    check_output(paddle.ops.l1_loss, lambda a, b: np.abs(a - b).mean(), [x, y])
+    check_grad(paddle.ops.mse_loss, [x, y])
+    check_grad(paddle.ops.binary_cross_entropy_with_logits, [s(4, 3), (u(4, 3) > 1.0).astype("float64")], wrt=[0])

@@ -1,0 +1,95 @@
+"""Regression tests for review findings (kwarg grads, pad order, PyLayer
+alignment, ignore_index, softplus overflow, ceil_mode, bf16 flag)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_kwarg_tensor_gets_grad():
+    x = paddle.randn([4, 8])
+    w = paddle.ones([8]); w.stop_gradient = False
+    b = paddle.zeros([8]); b.stop_gradient = False
+    out = paddle.ops.layer_norm(x, weight=w, bias=b)
+    out.sum().backward()
+    assert w.grad is not None and b.grad is not None
+    np.testing.assert_allclose(b.grad.numpy(), np.full(8, 4.0), rtol=1e-5)
+
+
+def test_pad_pair_order_matches_paddle():
+    x = paddle.ones([1, 1, 3, 3])
+    out = paddle.ops.pad(x, [1, 2, 0, 0])  # pads W by (1,2), H untouched
+    assert out.shape == (1, 1, 3, 6)
+    out2 = paddle.ops.pad(x, [0, 0, 3, 4])  # pads H by (3,4)
+    assert out2.shape == (1, 1, 10, 3)
+
+
+def test_pylayer_mixed_stop_gradient_alignment():
+    class Mix(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            return a + 2 * b
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 100, g * 2  # (grad_a, grad_b)
+
+    a = paddle.ones([3])  # stop_gradient=True
+    b = paddle.ones([3]); b.stop_gradient = False
+    out = Mix.apply(a, b)
+    out.sum().backward()
+    assert a.grad is None
+    np.testing.assert_allclose(b.grad.numpy(), [2.0, 2.0, 2.0])
+
+
+def test_cross_entropy_negative_ignore_index():
+    logits = paddle.to_tensor(np.random.randn(4, 5).astype("float32"),
+                              stop_gradient=False)
+    labels = paddle.to_tensor(np.array([1, -100, 2, -100]))
+    loss = paddle.ops.cross_entropy(logits, labels, ignore_index=-100)
+    # only 2 valid rows contribute; finite and grads zero on ignored rows
+    assert np.isfinite(loss.item())
+    loss.backward()
+    g = logits.grad.numpy()
+    np.testing.assert_allclose(g[1], 0.0, atol=1e-7)
+    np.testing.assert_allclose(g[3], 0.0, atol=1e-7)
+    assert np.abs(g[0]).sum() > 0
+
+
+def test_softplus_large_input_grad():
+    x = paddle.to_tensor([100.0], stop_gradient=False)
+    y = paddle.ops.softplus(x)
+    y.backward()
+    np.testing.assert_allclose(y.numpy(), [100.0])
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])
+
+
+def test_max_pool_ceil_mode():
+    x = paddle.randn([1, 1, 5, 5])
+    out = paddle.ops.max_pool2d(x, 2, stride=2, ceil_mode=True)
+    assert out.shape == (1, 1, 3, 3)
+    out = paddle.ops.max_pool2d(x, 2, stride=2, ceil_mode=False)
+    assert out.shape == (1, 1, 2, 2)
+    a = paddle.ops.avg_pool2d(x, 2, stride=2, ceil_mode=True)
+    assert a.shape == (1, 1, 3, 3)
+    # exclusive counting: corner cell averages only the 1 real element
+    np.testing.assert_allclose(a.numpy()[0, 0, 2, 2], x.numpy()[0, 0, 4, 4],
+                               rtol=1e-6)
+
+
+def test_bf16_matmul_flag():
+    a = paddle.ones([8, 8]).astype(paddle.bfloat16)
+    b = paddle.ones([8, 8]).astype(paddle.bfloat16)
+    paddle.set_flags({"FLAGS_use_bf16_matmul": False})
+    try:
+        out = paddle.matmul(a, b)
+        assert out.dtype == paddle.bfloat16
+    finally:
+        paddle.set_flags({"FLAGS_use_bf16_matmul": True})
+    out = paddle.matmul(a, b)
+    assert out.dtype == paddle.bfloat16
+    np.testing.assert_allclose(out.numpy().astype("float32"), np.full((8, 8), 8.0))
+
+
+def test_in_dynamic_mode_importable():
+    assert paddle.in_dynamic_mode() in (True, False)

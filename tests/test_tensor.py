@@ -1,0 +1,160 @@
+"""Tensor basics: creation, dtype rules, operators, indexing, numpy interop.
+
+Models the reference's tensor unittests
+(python/paddle/fluid/tests/unittests/test_var_base.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_basic():
+    t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == (2, 2)
+    assert t.dtype == paddle.float32
+    np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+
+def test_default_dtypes():
+    assert paddle.to_tensor(1.5).dtype == paddle.float32
+    assert paddle.to_tensor(3).dtype == paddle.int64
+    assert paddle.to_tensor(True).dtype == np.bool_
+    assert paddle.to_tensor(np.float64(2.0)).dtype == paddle.float32
+    assert paddle.to_tensor(np.array([1], dtype="int32")).dtype == paddle.int32
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == (2, 3)
+    assert paddle.ones([4], dtype="int32").dtype == paddle.int32
+    np.testing.assert_allclose(paddle.full([2], 7.0).numpy(), [7, 7])
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    e = paddle.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3))
+    z = paddle.zeros_like(paddle.ones([2, 2]))
+    np.testing.assert_allclose(z.numpy(), np.zeros((2, 2)))
+
+
+def test_arithmetic_operators():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2.0 + a).numpy(), [3, 4, 5])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2, -3])
+    np.testing.assert_allclose(abs(paddle.to_tensor([-1.0, 2.0])).numpy(), [1, 2])
+
+
+def test_comparison_and_logic():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a > b).numpy(), [False, False, True])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+    assert bool(paddle.ops.allclose(a, a))
+
+
+def test_matmul():
+    a = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    b = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+    c = a @ b
+    np.testing.assert_allclose(c.numpy(), a.numpy() @ b.numpy())
+    assert paddle.matmul(a, b).shape == (2, 4)
+
+
+def test_indexing():
+    x = paddle.to_tensor(np.arange(24, dtype="float32").reshape(2, 3, 4))
+    np.testing.assert_allclose(x[0].numpy(), x.numpy()[0])
+    np.testing.assert_allclose(x[:, 1].numpy(), x.numpy()[:, 1])
+    np.testing.assert_allclose(x[0, 1, 2].item(), 6.0)
+    np.testing.assert_allclose(x[..., -1].numpy(), x.numpy()[..., -1])
+    idx = paddle.to_tensor([0, 1])
+    np.testing.assert_allclose(x[idx].numpy(), x.numpy()[[0, 1]])
+
+
+def test_setitem():
+    x = paddle.zeros([3, 3])
+    x[1] = 5.0
+    np.testing.assert_allclose(x.numpy()[1], [5, 5, 5])
+    x[0, 0] = 1.0
+    assert x[0, 0].item() == 1.0
+
+
+def test_reshape_and_friends():
+    x = paddle.to_tensor(np.arange(12, dtype="float32"))
+    assert x.reshape([3, 4]).shape == (3, 4)
+    assert x.reshape([3, -1]).shape == (3, 4)
+    assert x.reshape([3, 4]).transpose([1, 0]).shape == (4, 3)
+    assert x.reshape([1, 12, 1]).squeeze().shape == (12,)
+    assert x.unsqueeze(0).shape == (1, 12)
+    assert x.reshape([3, 4]).flatten().shape == (12,)
+    assert paddle.concat([x, x]).shape == (24,)
+    assert paddle.stack([x, x]).shape == (2, 12)
+    parts = paddle.split(x.reshape([3, 4]), 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+    parts = paddle.split(x.reshape([3, 4]), [1, 3], axis=1)
+    assert parts[1].shape == (3, 3)
+
+
+def test_reductions():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    assert x.sum().item() == 15.0
+    np.testing.assert_allclose(x.sum(axis=0).numpy(), [3, 5, 7])
+    assert x.mean().item() == 2.5
+    assert x.max().item() == 5.0
+    assert x.argmax().item() == 5
+    np.testing.assert_allclose(x.min(axis=1).numpy(), [0, 3])
+    assert x.prod(axis=1).shape == (2,)
+
+
+def test_cast():
+    x = paddle.to_tensor([1.5, 2.5])
+    y = x.astype("int32")
+    assert y.dtype == paddle.int32
+    assert y.stop_gradient
+    z = x.astype(paddle.bfloat16)
+    assert z.dtype == paddle.bfloat16
+
+
+def test_topk_sort():
+    x = paddle.to_tensor([3.0, 1.0, 4.0, 1.0, 5.0])
+    vals, idx = paddle.topk(x, 2)
+    np.testing.assert_allclose(vals.numpy(), [5, 4])
+    np.testing.assert_array_equal(idx.numpy(), [4, 2])
+    np.testing.assert_allclose(paddle.sort(x).numpy(), [1, 1, 3, 4, 5])
+
+
+def test_where_gather_scatter():
+    x = paddle.to_tensor([1.0, 2.0, 3.0, 4.0])
+    cond = paddle.to_tensor([True, False, True, False])
+    np.testing.assert_allclose(paddle.where(cond, x, -x).numpy(), [1, -2, 3, -4])
+    np.testing.assert_allclose(paddle.gather(x, paddle.to_tensor([2, 0])).numpy(), [3, 1])
+    out = paddle.scatter(x, paddle.to_tensor([0, 1]), paddle.to_tensor([10.0, 20.0]))
+    np.testing.assert_allclose(out.numpy(), [10, 20, 3, 4])
+
+
+def test_random_reproducible():
+    paddle.seed(42)
+    a = paddle.randn([4])
+    paddle.seed(42)
+    b = paddle.randn([4])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    u = paddle.uniform([1000], min=0.0, max=1.0)
+    assert 0.0 <= float(u.min()) and float(u.max()) <= 1.0
+
+
+def test_einsum():
+    a = np.random.rand(2, 3).astype("float32")
+    b = np.random.rand(3, 4).astype("float32")
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_detach_and_clone():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    d = x.detach()
+    assert d.stop_gradient
+    c = x.clone()
+    assert not c.stop_gradient
