@@ -1,0 +1,119 @@
+"""Flagship benchmark: BERT-base MLM training step on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is measured MFU / 0.40 — the north-star target from BASELINE.md
+(>=40% MFU; the reference repo publishes no numbers of its own).
+Peak bf16 flops per v5e chip: 197 TFLOP/s (v5e spec sheet figure).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+BATCH = int(os.environ.get("BENCH_BATCH", 32))
+SEQ = int(os.environ.get("BENCH_SEQ", 128))
+STEPS = int(os.environ.get("BENCH_STEPS", 20))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 5))
+DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
+PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer as opt_mod
+    from paddle_tpu.core import rng as _rng
+    from paddle_tpu.core import tape as _tape
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.text.models.bert import (Bert, BertConfig,
+                                             BertPretrainingCriterion)
+
+    cfg = BertConfig.bert_base()
+    paddle.seed(0)
+    net = Bert(cfg)
+    net.train()
+    criterion = BertPretrainingCriterion(cfg.vocab_size)
+    optimizer = opt_mod.AdamW(learning_rate=1e-4,
+                              parameters=net.parameters())
+
+    params, buffers = net.functional_state()
+    if DTYPE == "bfloat16":
+        # bf16 params + bf16 compute, f32 MXU accumulation (ops/linalg.py);
+        # optimizer runs on the bf16 master copy this round (true master-
+        # weight AMP lands with paddle_tpu.amp O2).
+        params = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
+                  for k, v in params.items()}
+    named = dict(net.named_parameters())
+    optimizer._ensure_slots(params)
+    slots = dict(optimizer._slots)
+    meta = optimizer._param_meta(named)
+    n_params = int(sum(np.prod(v.shape) for v in params.values()))
+
+    def train_step(params, slots, ids, labels, lr, t, key):
+        with _rng.rng_state(key), _tape.no_grad():
+            def loss_of(p):
+                net.load_functional_state(p, buffers)
+                logits = net(Tensor(ids, _internal=True))
+                loss = criterion(logits, Tensor(labels, _internal=True))
+                return loss._value.astype(jnp.float32)
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            new_params, new_slots = optimizer.apply_gradients_pure(
+                params, grads, slots, lr, t, param_meta=meta)
+        return loss, new_params, new_slots
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(4, cfg.vocab_size, (BATCH, SEQ)), jnp.int64)
+    mask = rng.rand(BATCH, SEQ) < 0.15
+    labels = jnp.asarray(np.where(mask, rng.randint(4, cfg.vocab_size,
+                                                    (BATCH, SEQ)), -100),
+                         jnp.int64)
+    lr = jnp.asarray(1e-4, jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    t_arr = jnp.asarray(1, jnp.int32)
+    for i in range(WARMUP):
+        loss, params, slots = step(params, slots, ids, labels, lr, t_arr, key)
+    # NOTE: a host readback is the sync point — block_until_ready does not
+    # reliably block through the remote-tunnel PJRT plugin.
+    _ = float(np.asarray(loss))
+
+    t0 = time.perf_counter()
+    for i in range(STEPS):
+        loss, params, slots = step(params, slots, ids, labels, lr, t_arr, key)
+    final_loss = float(np.asarray(loss))
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = STEPS / dt
+    samples_per_sec = steps_per_sec * BATCH
+    tokens = BATCH * SEQ
+    # 6ND for matmul params + attention quadratic term (fwd 1x, bwd 2x)
+    L, H = cfg.num_hidden_layers, cfg.hidden_size
+    attn_flops = 12 * L * H * SEQ * tokens
+    flops_per_step = 6 * n_params * tokens + attn_flops
+    mfu = flops_per_step * steps_per_sec / PEAK_FLOPS
+
+    result = {
+        "metric": f"bert_base_mlm_train_b{BATCH}_s{SEQ}_{DTYPE}",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "mfu": round(mfu, 4),
+        "loss": final_loss,
+        "step_ms": round(1000 * dt / STEPS, 2),
+        "params": n_params,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

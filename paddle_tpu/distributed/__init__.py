@@ -1,0 +1,37 @@
+"""paddle.distributed — mesh-first distributed training.
+
+TPU-native re-design of the reference's distributed stack (SURVEY.md §2.2,
+§2.3): NCCL ring_id registries + program-rewriting meta-optimizers become
+named mesh axes + sharding rules + XLA-inserted ICI collectives.
+"""
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from . import mesh  # noqa: F401
+from .mesh import get_mesh, init_mesh, mesh_axis_size, in_spmd_region  # noqa: F401
+
+import importlib as _importlib
+
+_LAZY_MODULES = ("fleet", "sharding", "pipeline", "launch", "spawn", "moe",
+                 "collective", "parallel", "ring_attention")
+_LAZY_NAMES = {
+    "all_gather": "collective", "all_reduce": "collective",
+    "alltoall": "collective", "barrier": "collective",
+    "broadcast": "collective", "recv": "collective", "reduce": "collective",
+    "reduce_scatter": "collective", "scatter": "collective",
+    "send": "collective", "ReduceOp": "collective", "split": "collective",
+    "DataParallel": "parallel", "init_parallel_env": "parallel",
+    "ring_attention_fn": "ring_attention",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_MODULES:
+        mod = _importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name in _LAZY_NAMES:
+        mod = _importlib.import_module(f".{_LAZY_NAMES[name]}", __name__)
+        val = getattr(mod, name if name != "ring_attention_fn" else "ring_attention")
+        globals()[name] = val
+        return val
+    raise AttributeError(
+        f"module 'paddle_tpu.distributed' has no attribute {name!r}")

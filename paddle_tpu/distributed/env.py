@@ -1,0 +1,62 @@
+"""Distributed environment contract.
+
+Analog of the reference's PADDLE_* env protocol
+(reference: python/paddle/distributed/utils.py:406-409 sets
+PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS;
+parallel.py:138-141 ParallelEnv reads them). On TPU the same variables
+select the jax process (multi-host) and data-parallel rank.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["ParallelEnv", "get_rank", "get_world_size"]
+
+
+def get_rank() -> int:
+    v = os.environ.get("PADDLE_TRAINER_ID")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    v = os.environ.get("PADDLE_TRAINERS_NUM")
+    if v is not None:
+        return int(v)
+    try:
+        import jax
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_RANK_IN_NODE", get_rank()))
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]

@@ -1,0 +1,118 @@
+"""Named-mesh registry.
+
+TPU-native replacement for the reference's NCCL communicator registry
+(reference: paddle/fluid/platform/collective_helper.h:63 NCCLCommContext —
+process-global map ring_id→device→NCCLComm, populated by c_gen_nccl_id +
+c_comm_init startup ops). Design delta (SURVEY.md §2.3, §5.8): communicators
+become mesh AXES declared once; collectives become XLA HLO emitted by the
+partitioner over ICI/DCN; there are no comm streams or sync ops to manage.
+
+Axis-name conventions used across the framework:
+  dp — data parallel         tp — tensor (model) parallel
+  pp — pipeline parallel     sp — sequence/context parallel
+  ep — expert parallel (MoE)
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["init_mesh", "get_mesh", "set_mesh", "mesh_axis_size",
+           "in_spmd_region", "named_sharding", "MeshGuard", "auto_mesh"]
+
+_lock = threading.Lock()
+_meshes: Dict[str, Mesh] = {}
+_default_name: Optional[str] = None
+
+
+def init_mesh(shape: Dict[str, int] = None, name: str = "default",
+              devices=None) -> Mesh:
+    """Declare a named mesh once (the c_comm_init analog).
+
+    shape: ordered {axis_name: size}; product must equal device count.
+    Defaults to a pure data-parallel mesh over all devices.
+    """
+    global _default_name
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = {"dp": len(devices)}
+    sizes = list(shape.values())
+    if int(np.prod(sizes)) != len(devices):
+        raise ValueError(
+            f"mesh shape {shape} needs {int(np.prod(sizes))} devices, "
+            f"have {len(devices)}")
+    arr = np.array(devices).reshape(sizes)
+    mesh = Mesh(arr, tuple(shape.keys()))
+    with _lock:
+        _meshes[name] = mesh
+        if _default_name is None or name == "default":
+            _default_name = name
+    return mesh
+
+
+def set_mesh(mesh: Mesh, name: str = "default"):
+    global _default_name
+    with _lock:
+        _meshes[name] = mesh
+        _default_name = name
+    return mesh
+
+
+def get_mesh(name: str = None) -> Optional[Mesh]:
+    with _lock:
+        if name is not None:
+            return _meshes.get(name)
+        if _default_name is not None:
+            return _meshes.get(_default_name)
+    return None
+
+
+def auto_mesh() -> Mesh:
+    """Get-or-create the default mesh (pure DP over all devices)."""
+    m = get_mesh()
+    if m is None:
+        m = init_mesh()
+    return m
+
+
+def mesh_axis_size(axis: str, name: str = None) -> int:
+    m = get_mesh(name)
+    if m is None or axis not in m.axis_names:
+        return 1
+    return m.shape[axis]
+
+
+def in_spmd_region(axis: str = None) -> bool:
+    """True when tracing inside shard_map/pjit where `axis` is bound —
+    i.e. lax.psum(axis) is legal here."""
+    try:
+        core = jax.core
+        env_axes = core.unsafe_get_axis_names() if hasattr(core, "unsafe_get_axis_names") else []
+    except Exception:
+        env_axes = []
+    if axis is None:
+        return bool(env_axes)
+    return axis in env_axes
+
+
+def named_sharding(spec: PartitionSpec, name: str = None) -> NamedSharding:
+    return NamedSharding(auto_mesh() if name is None else get_mesh(name), spec)
+
+
+class MeshGuard:
+    """`with MeshGuard(mesh):` — scope the jax mesh context manager."""
+
+    def __init__(self, mesh: Mesh = None, name: str = None):
+        self.mesh = mesh or get_mesh(name)
+
+    def __enter__(self):
+        self._cm = self.mesh
+        self._cm.__enter__()
+        return self.mesh
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)

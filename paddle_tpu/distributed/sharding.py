@@ -1,0 +1,126 @@
+"""Sharding rules — the TP/ZeRO presets.
+
+TPU-native replacement for the reference's program-surgery parallelism
+(reference: fleet/meta_optimizers/sharding_optimizer.py:33 ShardingOptimizer
+— splits params/grads/opt-states and inserts broadcast/reduce ops; and the
+manual Megatron-style c_allgather/c_reducescatter assembly, SURVEY.md §2.2
+"TP"). Design delta: parallelism is declared as PartitionSpecs per parameter
+NAME PATTERN; GSPMD partitions the jitted step and inserts the ICI
+collectives the reference wrote by hand.
+
+Conventions (our Linear weight is [in, out]):
+  column-parallel (shard output dim):  qkv/q/k/v projections, ffn up-proj
+  row-parallel   (shard input dim):    attention out-proj, ffn down-proj
+  vocab-parallel (shard rows):         word embeddings / tied LM head
+ZeRO-style sharded-DP shards every remaining (replicated) param and its
+optimizer slots along 'dp' on dim 0 when enabled.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_mod
+
+__all__ = ["param_spec_for", "build_param_shardings", "COLUMN_PARALLEL",
+           "ROW_PARALLEL", "VOCAB_PARALLEL", "add_tp_rule",
+           "shard_optimizer_state", "group_sharded_parallel"]
+
+COLUMN_PARALLEL = [
+    r"qkv_proj\.weight$", r"q_proj\.weight$", r"k_proj\.weight$",
+    r"v_proj\.weight$", r"linear1\.weight$", r"fc1\.weight$",
+    r"mlm_transform\.weight$",
+]
+COLUMN_PARALLEL_BIAS = [
+    r"qkv_proj\.bias$", r"q_proj\.bias$", r"k_proj\.bias$",
+    r"v_proj\.bias$", r"linear1\.bias$", r"fc1\.bias$",
+    r"mlm_transform\.bias$",
+]
+ROW_PARALLEL = [
+    r"out_proj\.weight$", r"linear2\.weight$", r"fc2\.weight$",
+]
+VOCAB_PARALLEL = [
+    r"word_embeddings\.weight$", r"wte\.weight$",
+]
+
+_extra_rules = []  # (regex, spec_builder(ndim) -> P)
+
+
+def add_tp_rule(pattern: str, spec: P):
+    """Register a custom tensor-parallel rule (most-specific wins last)."""
+    _extra_rules.append((re.compile(pattern), spec))
+
+
+def _match(name, patterns):
+    return any(re.search(p, name) for p in patterns)
+
+
+def param_spec_for(name: str, ndim: int, mesh: Optional[Mesh] = None,
+                   zero_dp: bool = False) -> P:
+    """PartitionSpec for a parameter by name pattern."""
+    m = mesh or mesh_mod.get_mesh()
+    axes = set(m.axis_names) if m is not None else set()
+    has_tp = "tp" in axes
+
+    for rx, spec in reversed(_extra_rules):
+        if rx.search(name):
+            return spec
+    if has_tp and ndim >= 2:
+        if _match(name, COLUMN_PARALLEL):
+            return P(*([None] * (ndim - 1) + ["tp"]))
+        if _match(name, ROW_PARALLEL):
+            return P(*(["tp"] + [None] * (ndim - 1)))
+        if _match(name, VOCAB_PARALLEL):
+            return P(*(["tp"] + [None] * (ndim - 1)))
+    if has_tp and ndim == 1 and _match(name, COLUMN_PARALLEL_BIAS):
+        return P("tp")
+    if zero_dp and "dp" in axes and ndim >= 1:
+        # ZeRO-3-style: shard dim 0 of everything not already tp-sharded
+        return P(*(["dp"] + [None] * (ndim - 1)))
+    return P()
+
+
+def build_param_shardings(params: Dict[str, "jax.Array"],
+                          mesh: Optional[Mesh] = None,
+                          zero_dp: bool = False) -> Dict[str, NamedSharding]:
+    m = mesh or mesh_mod.auto_mesh()
+    out = {}
+    for name, v in params.items():
+        spec = param_spec_for(name, v.ndim, m, zero_dp=zero_dp)
+        spec = _validate_divisible(spec, v.shape, m)
+        out[name] = NamedSharding(m, spec)
+    return out
+
+
+def _validate_divisible(spec: P, shape, mesh: Mesh) -> P:
+    """Drop axis shardings that don't divide the dim (falls back to
+    replication for that dim, like GSPMD would pad — we prefer explicit)."""
+    new = []
+    for dim, ax in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if ax is None:
+            new.append(None)
+        else:
+            size = mesh.shape[ax] if ax in mesh.axis_names else 1
+            new.append(ax if dim % size == 0 else None)
+    return P(*new)
+
+
+def shard_optimizer_state(slot_tree: Dict[str, Dict[str, "jax.Array"]],
+                          param_shardings: Dict[str, NamedSharding]):
+    """Optimizer slots inherit their parameter's sharding (the
+    ShardingOptimizer §2.2 'shard opt states' half)."""
+    return {k: {s: param_shardings[k] for s in slots}
+            for k, slots in slot_tree.items()}
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None):
+    """API parity with paddle.distributed.sharding.group_sharded_parallel:
+    marks the model/optimizer for ZeRO-style sharded data parallel. The
+    actual partitioning happens in the compiled step via
+    build_param_shardings(zero_dp=True)."""
+    model._zero_dp = True
+    if optimizer is not None:
+        optimizer._zero_dp = True
+    return model, optimizer, scaler

@@ -1,0 +1,2 @@
+from . import callbacks  # noqa: F401
+from .model import InputSpec, Model  # noqa: F401

@@ -1,0 +1,538 @@
+"""High-level Model API.
+
+Analog of reference python/paddle/hapi/model.py (Model :808, fit :1296,
+prepare :1241, StaticGraphAdapter :223 / DynamicGraphAdapter :608).
+
+Design delta (SURVEY.md §7.3): the two adapters collapse into ONE compiled
+engine. The layer graph is traced functionally — parameters, buffers and
+optimizer slots become pytree inputs/outputs of a pure step function that
+jax.jit compiles to a single XLA program (forward + backward + optimizer
+fused; buffers donated). That one program per (mode, shapes) replaces both
+the static Executor program and the dygraph per-op path. Sharding hooks:
+when paddle_tpu.distributed configured a mesh + sharding rules, the same
+step is pjit-partitioned (engine consults distributed.sharding).
+"""
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng as _rng
+from ..core import tape as _tape
+from ..core.tensor import Tensor
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from ..framework.io import load as _load, save as _save
+from .callbacks import config_callbacks
+
+__all__ = ["Model", "InputSpec"]
+
+
+class InputSpec:
+    """Shape/dtype declaration (reference paddle/static/input.py InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_raw(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+class _CompiledEngine:
+    """Traces net+loss+optimizer into pure jitted step functions."""
+
+    def __init__(self, model):
+        self.model = model
+        self._train_fn = None
+        self._eval_fn = None
+        self._pred_fn = None
+        self._grad_fn = None
+        self._apply_fn = None
+        self._accum_grads = None
+        self._accum_count = 0
+        self._param_names = None
+
+    # ---- functional pieces -------------------------------------------------
+    def _forward_loss(self, params, buffers, inputs, labels, training):
+        net = self.model.network
+        net.load_functional_state(params, buffers)
+        tin = [Tensor(v, stop_gradient=True, _internal=True) for v in inputs]
+        outs = net(*tin)
+        outs_list = _to_list(outs)
+        loss = None
+        if self.model._loss is not None and labels is not None:
+            tlab = [Tensor(v, stop_gradient=True, _internal=True)
+                    for v in labels]
+            loss = self.model._compute_loss(outs_list, tlab)
+        new_bufs = {n: b._value for n, b in net.named_buffers()}
+        raw_outs = [o._value for o in outs_list]
+        return loss, raw_outs, new_bufs
+
+    def _build_train_fn(self):
+        model = self.model
+        opt = model._optimizer
+        net = model.network
+        params, _ = net.functional_state()
+        named = {n: p for n, p in net.named_parameters()}
+        trainable = {n for n, p in named.items() if not p.stop_gradient}
+        meta = opt._param_meta(named)
+
+        def step(params, buffers, slots, lr, t, key, inputs, labels):
+            with _rng.rng_state(key), _tape.no_grad():
+                train_p = {k: v for k, v in params.items() if k in trainable}
+                frozen_p = {k: v for k, v in params.items()
+                            if k not in trainable}
+
+                def loss_of(tp):
+                    full = dict(frozen_p)
+                    full.update(tp)
+                    loss, raw_outs, new_bufs = self._forward_loss(
+                        full, buffers, inputs, labels, True)
+                    return loss._value, (raw_outs, new_bufs)
+
+                (lval, (outs, new_bufs)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(train_p)
+                new_train, new_slots = opt.apply_gradients_pure(
+                    train_p, grads, slots, lr, t, param_meta=meta)
+                new_params = dict(frozen_p)
+                new_params.update(new_train)
+            return lval, outs, new_bufs, new_params, new_slots
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _build_grad_fn(self):
+        """Forward+backward only — used for gradient accumulation
+        (GradientMergeOptimizer analog, reference fluid/optimizer.py:5004)."""
+        net = self.model.network
+        named = {n: p for n, p in net.named_parameters()}
+        trainable = {n for n, p in named.items() if not p.stop_gradient}
+
+        def gstep(params, buffers, key, inputs, labels):
+            with _rng.rng_state(key), _tape.no_grad():
+                train_p = {k: v for k, v in params.items() if k in trainable}
+                frozen_p = {k: v for k, v in params.items()
+                            if k not in trainable}
+
+                def loss_of(tp):
+                    full = dict(frozen_p)
+                    full.update(tp)
+                    loss, raw_outs, new_bufs = self._forward_loss(
+                        full, buffers, inputs, labels, True)
+                    return loss._value, (raw_outs, new_bufs)
+
+                (lval, (outs, new_bufs)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(train_p)
+            return lval, outs, new_bufs, grads
+
+        return jax.jit(gstep)
+
+    def _build_apply_fn(self):
+        opt = self.model._optimizer
+        named = dict(self.model.network.named_parameters())
+        meta = opt._param_meta(named)
+
+        def apply(params, slots, grads, lr, t, scale):
+            grads = {k: g * scale for k, g in grads.items()}
+            new_train, new_slots = opt.apply_gradients_pure(
+                {k: params[k] for k in grads}, grads, slots, lr, t,
+                param_meta=meta)
+            new_params = dict(params)
+            new_params.update(new_train)
+            return new_params, new_slots
+
+        return jax.jit(apply, donate_argnums=(0, 1))
+
+    def _build_eval_fn(self):
+        def step(params, buffers, key, inputs, labels):
+            with _rng.rng_state(key), _tape.no_grad():
+                loss, raw_outs, _ = self._forward_loss(
+                    params, buffers, inputs, labels, False)
+            lval = loss._value if loss is not None else jnp.zeros(())
+            return lval, raw_outs
+
+        return jax.jit(step)
+
+    def _build_pred_fn(self):
+        def step(params, buffers, key, inputs):
+            with _rng.rng_state(key), _tape.no_grad():
+                _, raw_outs, _ = self._forward_loss(params, buffers, inputs,
+                                                    None, False)
+            return raw_outs
+
+        return jax.jit(step)
+
+    # ---- public steps ------------------------------------------------------
+    def train_batch(self, inputs, labels, update=True):
+        model = self.model
+        net = model.network
+        net.train()
+        opt = model._optimizer
+        params, buffers = net.functional_state()
+        named = dict(net.named_parameters())
+        opt._ensure_slots({k: v for k, v in params.items()
+                           if not named[k].stop_gradient})
+        slots = {k: opt._slots[k] for k in opt._slots
+                 if k in params and not named[k].stop_gradient}
+        raw_in = tuple(_to_raw(v) for v in inputs)
+        raw_lab = tuple(_to_raw(v) for v in labels)
+        accumulating = (not update) or self._accum_grads is not None
+
+        if not accumulating:
+            # fast path: forward+backward+update fused in one XLA program
+            if self._train_fn is None:
+                self._train_fn = self._build_train_fn()
+            opt._step_count += 1
+            lval, outs, new_bufs, new_params, new_slots = self._train_fn(
+                params, buffers, slots,
+                jnp.asarray(opt.get_lr(), jnp.float32),
+                jnp.asarray(opt._step_count, jnp.int32),
+                _rng.next_key(), raw_in, raw_lab)
+            self._write_back(new_params, new_bufs)
+            opt._slots.update(new_slots)
+            return lval, outs
+
+        # accumulation path: grads summed across micro-batches, applied on
+        # the update call (grads averaged by micro-batch count)
+        if self._grad_fn is None:
+            self._grad_fn = self._build_grad_fn()
+        lval, outs, new_bufs, grads = self._grad_fn(
+            params, buffers, _rng.next_key(), raw_in, raw_lab)
+        self._write_back({}, new_bufs)
+        self._restore(params, {})
+        if self._accum_grads is None:
+            self._accum_grads = grads
+            self._accum_count = 1
+        else:
+            self._accum_grads = jax.tree_util.tree_map(
+                jnp.add, self._accum_grads, grads)
+            self._accum_count += 1
+        if update:
+            if self._apply_fn is None:
+                self._apply_fn = self._build_apply_fn()
+            opt._step_count += 1
+            new_params, new_slots = self._apply_fn(
+                params, slots, self._accum_grads,
+                jnp.asarray(opt.get_lr(), jnp.float32),
+                jnp.asarray(opt._step_count, jnp.int32),
+                jnp.asarray(1.0 / self._accum_count, jnp.float32))
+            self._write_back(new_params, {})
+            opt._slots.update(new_slots)
+            self._accum_grads = None
+            self._accum_count = 0
+        return lval, outs
+
+    def eval_batch(self, inputs, labels):
+        net = self.model.network
+        net.eval()
+        params, buffers = net.functional_state()
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval_fn()
+        lval, outs = self._eval_fn(
+            params, buffers, _rng.next_key(),
+            tuple(_to_raw(v) for v in inputs),
+            tuple(_to_raw(v) for v in labels) if labels else None)
+        self._restore(params, buffers)
+        return lval, outs
+
+    def predict_batch(self, inputs):
+        net = self.model.network
+        net.eval()
+        params, buffers = net.functional_state()
+        if self._pred_fn is None:
+            self._pred_fn = self._build_pred_fn()
+        outs = self._pred_fn(params, buffers, _rng.next_key(),
+                             tuple(_to_raw(v) for v in inputs))
+        self._restore(params, buffers)
+        return outs
+
+    def _write_back(self, new_params, new_bufs):
+        net = self.model.network
+        for n, p in net.named_parameters():
+            if n in new_params:
+                p._value = new_params[n]
+                p._node = None
+                p.grad = None
+        for n, b in net.named_buffers():
+            if n in new_bufs:
+                b._value = new_bufs[n]
+                b._node = None
+
+    def _restore(self, params, buffers):
+        # forward inside jit seats tracers into the layer; put values back
+        net = self.model.network
+        net.load_functional_state(params, buffers)
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._engine = _CompiledEngine(self)
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer) or callable(loss)):
+            raise TypeError("loss must be a Layer or callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be Metric instances, got {m}")
+        self._amp_configs = amp_configs
+        return self
+
+    def _compute_loss(self, outputs, labels):
+        loss = self._loss
+        if isinstance(loss, list):
+            vals = [fn(o, l) for fn, o, l in zip(loss, outputs, labels)]
+            total = vals[0]
+            for v in vals[1:]:
+                total = total + v
+            return total
+        return loss(*(outputs + labels))
+
+    # -- batch-level API -----------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        lval, outs = self._engine.train_batch(_to_list(inputs),
+                                              _to_list(labels),
+                                              update=update)
+        return self._wrap_loss(lval)
+
+    def eval_batch(self, inputs, labels=None):
+        lval, outs = self._engine.eval_batch(_to_list(inputs),
+                                             _to_list(labels))
+        return self._wrap_loss(lval)
+
+    def predict_batch(self, inputs):
+        outs = self._engine.predict_batch(_to_list(inputs))
+        return [np.asarray(o) for o in outs]
+
+    @staticmethod
+    def _wrap_loss(lval):
+        return [float(np.asarray(lval))]
+
+    # -- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer=..., loss=...) before fit()"
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        do_eval = eval_loader is not None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                save_freq=save_freq, save_dir=save_dir,
+                                verbose=verbose,
+                                metrics=self._metrics_name())
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(train_loader, cbks, "train",
+                                       num_iters=num_iters,
+                                       accum=accumulate_grad_batches)
+            cbks.on_epoch_end(epoch, logs)
+            if do_eval and epoch % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, callbacks=cbks,
+                                          _inside_fit=True)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, _inside_fit=False):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            lval, outs = self._engine.eval_batch(inputs, labels)
+            losses.append(float(np.asarray(lval)))
+            self._update_metrics(outs, labels)
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch, allow_no_label=True)
+            outs = self.predict_batch(inputs)
+            outputs.append(outs)
+        # transpose: list of per-batch lists -> per-output lists
+        n_out = len(outputs[0])
+        merged = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            merged = [np.concatenate(m) for m in merged]
+        return merged
+
+    def _run_one_epoch(self, loader, cbks, mode, num_iters=None, accum=1):
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        for step, batch in enumerate(loader):
+            cbks.on_batch_begin(mode, step, logs)
+            inputs, labels = self._split_batch(batch)
+            update = accum <= 1 or (step + 1) % accum == 0
+            lval, outs = self._engine.train_batch(inputs, labels,
+                                                  update=update)
+            if self._lr_sched_step_on_batch():
+                self._optimizer._learning_rate.step()
+            logs["loss"] = float(np.asarray(lval))
+            logs["batch_size"] = np.asarray(inputs[0]).shape[0]
+            metric_logs = self._update_metrics(outs, labels)
+            logs.update(metric_logs)
+            cbks.on_batch_end(mode, step, logs)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        if self._lr_sched_step_on_epoch():
+            self._optimizer._learning_rate.step()
+        return logs
+
+    def _lr_sched_step_on_batch(self):
+        from ..optimizer import lr as lr_mod
+        sched = self._optimizer._lr_scheduler if self._optimizer else None
+        return isinstance(sched, (lr_mod.NoamDecay, lr_mod.OneCycleLR,
+                                  lr_mod.CyclicLR, lr_mod.LinearWarmup))
+
+    def _lr_sched_step_on_epoch(self):
+        sched = self._optimizer._lr_scheduler if self._optimizer else None
+        return sched is not None and not self._lr_sched_step_on_batch()
+
+    def _update_metrics(self, outs, labels):
+        logs = {}
+        for m in self._metrics:
+            pre = m.compute(outs[0], *[np.asarray(_to_raw(l)) for l in labels])
+            if isinstance(pre, tuple):
+                m.update(*pre)
+            else:
+                m.update(pre)
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            logs.update(dict(zip(names, vals)))
+        return logs
+
+    def _split_batch(self, batch, allow_no_label=False):
+        n_in = max(len(self._inputs), 1)
+        if isinstance(batch, (list, tuple)):
+            batch = list(batch)
+            if len(batch) == 1:
+                return batch, []
+            if allow_no_label and len(batch) <= n_in:
+                return batch, []
+            inputs = batch[:n_in]
+            labels = batch[n_in:]
+            return inputs, labels
+        return [batch], []
+
+    def _metrics_name(self):
+        out = ["loss"]
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            out.extend(names)
+        return out
+
+    # -- persistence ---------------------------------------------------------
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def state_dict(self):
+        return self.network.state_dict()
+
+    def save(self, path, training=True):
+        """path prefix: writes {path}.pdparams (+ {path}.pdopt if training)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(_load(opt_path))
+        # drop compiled steps: weights changed wholesale
+        self._engine = _CompiledEngine(self)
+        return self
+
+    def summary(self, input_size=None, dtype=None):
+        rows = []
+        total = 0
+        for name, p in self.network.named_parameters():
+            rows.append((name, p.shape, p.size))
+            total += p.size
+        width = max((len(r[0]) for r in rows), default=10) + 2
+        lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Params':<12}"]
+        for name, shape, size in rows:
+            lines.append(f"{name:<{width}}{str(list(shape)):<20}{size:<12}")
+        lines.append(f"Total params: {total:,}")
+        text = "\n".join(lines)
+        print(text)
+        return {"total_params": total}

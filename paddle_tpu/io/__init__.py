@@ -1,0 +1,16 @@
+"""paddle.io — Dataset / DataLoader / samplers.
+
+Analog of reference python/paddle/fluid/dataloader/ (dataset.py,
+batch_sampler.py, dataloader_iter.py) and fluid/reader.py DataLoader.
+Design delta: the reference forks worker processes with shared-memory
+queues (reader.py:147); on TPU the input pipeline is host-side numpy with a
+background prefetch thread overlapping H2D with the device step — the
+BufferedReader double-buffering idea (operators/reader/buffered_reader.h:33)
+without per-op readers. A C++ channel-based feeder (paddle_tpu/_native) is
+the planned industrial path (framework/channel.h analog).
+"""
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,  # noqa: F401
+                      IterableDataset, Subset, TensorDataset, random_split)
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,  # noqa: F401
+                      Sampler, SequenceSampler, WeightedRandomSampler)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

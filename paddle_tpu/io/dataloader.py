@@ -1,0 +1,172 @@
+"""DataLoader with background prefetch.
+
+Analog of reference python/paddle/fluid/reader.py DataLoader (:147) +
+dataloader_iter.py. Worker model delta: a thread pool + bounded queue
+(double buffering) instead of forked workers over shared memory — the host
+work here is collation, and overlapping it with device steps is what matters
+on TPU (BufferedReader analog, operators/reader/buffered_reader.h:47).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn"]
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._value) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return np.asarray(batch)
+    return batch
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 2)
+        self.use_buffer_reader = use_buffer_reader
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def _batches(self):
+        if self._iterable_mode:
+            buf = []
+            for sample in self.dataset:
+                buf.append(sample)
+                if len(buf) == self.batch_size:
+                    yield self.collate_fn(buf)
+                    buf = []
+            if buf and not self.drop_last:
+                yield self.collate_fn(buf)
+            return
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _batches_threaded(self):
+        """Fetch batches with a worker pool; keep `prefetch_factor` in flight."""
+        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        sentinel = object()
+        stop = threading.Event()
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor * self.num_workers)
+
+        def fetch(indices):
+            return self.collate_fn([self.dataset[i] for i in indices])
+
+        def producer():
+            try:
+                for indices in self.batch_sampler:
+                    fut = pool.submit(fetch, indices)
+                    while not stop.is_set():  # bounded put that can abort
+                        try:
+                            q.put(fut, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        fut.cancel()
+                        return
+            finally:
+                while not stop.is_set():  # sentinel must arrive or be moot
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item.result()
+        finally:
+            stop.set()  # unblock producer if the consumer bailed early
+            pool.shutdown(wait=False)
+
+    def __iter__(self):
+        if self.num_workers > 0 and not self._iterable_mode:
+            gen = self._batches_threaded()
+        else:
+            gen = self._batches()
+        if not self.use_buffer_reader:
+            yield from gen
+            return
+        # double-buffer: keep one batch ahead so host collation overlaps
+        # the device step (BufferedReader semantics)
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor)
+        sentinel = object()
+        stop = threading.Event()
+        err = []
+
+        def producer():
+            try:
+                for b in gen:
+                    while not stop.is_set():
+                        try:
+                            q.put(b, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        gen.close() if hasattr(gen, "close") else None
+                        return
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                while not stop.is_set():  # sentinel must arrive or be moot
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    if err:
+                        raise err[0]
+                    break
+                yield item
+        finally:
+            stop.set()  # consumer abandoned mid-epoch: release the producer

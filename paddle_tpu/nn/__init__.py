@@ -1,0 +1,16 @@
+"""paddle.nn — layers, functional, initializers.
+
+Analog of reference python/paddle/nn/ (layer zoo over the dygraph Layer base,
+fluid/dygraph/layers.py:65).
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer import Layer, Parameter, ParamAttr  # noqa: F401
+
+def __getattr__(name):
+    # clip classes live in optimizer but are exposed as paddle.nn.* for parity
+    if name in ("ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"):
+        from ..optimizer import clip
+        return getattr(clip, name)
+    raise AttributeError(f"module 'paddle_tpu.nn' has no attribute {name!r}")

@@ -1,0 +1,98 @@
+"""paddle.nn.functional.
+
+Analog of reference python/paddle/nn/functional/: thin functional layer over
+the op library (ops/*), plus attention. Most names are re-exports; the ones
+with layer-level semantics (linear, embedding lookup argument order,
+attention) are defined here.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import ops
+from ...ops import (  # noqa: F401 — re-exported op families
+    relu, relu6, leaky_relu, prelu, elu, selu, celu, gelu, sigmoid,
+    hardsigmoid, hardswish, hardtanh, hardshrink, softshrink, tanhshrink,
+    silu, swish, mish, softplus, softsign, softmax, log_softmax, log_sigmoid,
+    gumbel_softmax, maxout, thresholded_relu, glu, normalize, tanh,
+    conv1d, conv2d, conv3d, conv2d_transpose,
+    max_pool1d, max_pool2d, avg_pool2d, adaptive_avg_pool2d,
+    adaptive_max_pool2d, interpolate, pixel_shuffle, unfold, pad,
+    layer_norm, instance_norm, group_norm, rms_norm, local_response_norm,
+    dropout, one_hot, embedding as _embedding_op,
+    cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,
+    smooth_l1_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
+    sigmoid_cross_entropy_with_logits, kl_div, margin_ranking_loss,
+    hinge_embedding_loss, cosine_similarity, label_smooth, square_error_cost,
+    log_loss, triplet_margin_loss, huber_loss,
+)
+from ...ops._dispatch import defop
+from ...core.tensor import Tensor
+
+upsample = interpolate
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Weight is [in, out] (reference nn.functional.common.linear)."""
+    out = ops.matmul(x, weight)
+    if bias is not None:
+        out = ops.add(out, bias)
+    return out
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Note the paddle-2.0 argument order (ids first)."""
+    return _embedding_op(weight, x, padding_idx=padding_idx, sparse=sparse)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    out = ops.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@defop
+def _sdpa(q, k, v, mask, scale, is_causal):
+    # q,k,v: [batch, heads, seq, head_dim]
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if is_causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + mask
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, scale=None,
+                                 training=True):
+    """Fused attention core. On TPU the Pallas flash-attention kernel
+    (paddle_tpu.ops.pallas) replaces this for long sequences; this reference
+    path lets XLA fuse the softmax chain."""
+    head_dim = query.shape[-1] if not isinstance(query, Tensor) else query.shape[-1]
+    sc = scale if scale is not None else head_dim ** -0.5
+    out = _sdpa(query, key, value, attn_mask, sc, is_causal)
+    if dropout_p > 0.0 and training:
+        out = dropout(out, p=dropout_p, training=True)
+    return out
+
+
+def unfold_linear(*a, **k):  # placeholder parity helper
+    raise NotImplementedError
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    from ...ops._dispatch import unwrap, wrap
+    import jax.numpy as jnp
+    lv = unwrap(lengths)
+    m = int(maxlen) if maxlen is not None else int(lv.max())
+    mask = jnp.arange(m)[None, :] < lv[..., None]
+    from ...core.dtype import to_jax_dtype
+    return wrap(mask.astype(to_jax_dtype(dtype)))

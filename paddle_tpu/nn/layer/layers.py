@@ -1,0 +1,383 @@
+"""Layer: the module base class.
+
+Analog of reference python/paddle/fluid/dygraph/layers.py:65 (`Layer` with
+parameters/sublayers/buffers/hooks/state_dict) and the C++ VarBase parameter
+ownership. Design delta: parameters are plain Tensors (stop_gradient=False);
+a Layer is also the unit of functional extraction — `functional_state` /
+`load_functional_state` flip all params/buffers to pytree values and back,
+which is how hapi/static build pure jitted train steps over stateful Layers
+(replacing the reference's Program-scope parameter store,
+fluid/framework.py:976 Variable + global scope).
+"""
+from __future__ import annotations
+
+import warnings
+from collections import OrderedDict
+from typing import Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...core.dtype import convert_dtype
+from ...utils import unique_name
+from .. import initializer as I
+
+__all__ = ["Layer", "Parameter", "ParamAttr"]
+
+
+class Parameter(Tensor):
+    """Trainable tensor owned by a Layer (reference: framework.py Parameter)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average",
+                 "need_clip", "is_distributed")
+
+    def __init__(self, value, name=None, trainable=True, regularizer=None,
+                 learning_rate=1.0, need_clip=True):
+        super().__init__(value, stop_gradient=not trainable)
+        self.name = name or unique_name.generate("param")
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": learning_rate}
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        self.is_distributed = False
+
+    def __repr__(self):
+        return (f"Parameter(name={self.name}, shape={list(self.shape)}, "
+                f"dtype={self.dtype.name}, trainable={self.trainable})\n"
+                f"{self._value}")
+
+
+class ParamAttr:
+    """Parameter configuration (reference: python/paddle/fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"bad ParamAttr spec: {attr!r}")
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, hook_id):
+        self._hooks = hooks
+        self._hook_id = hook_id
+
+    def remove(self):
+        self._hooks.pop(self._hook_id, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = convert_dtype(dtype)
+        self._full_name = unique_name.generate(
+            name_scope or type(self).__name__.lower())
+        self._parameters = OrderedDict()
+        self._sub_layers = OrderedDict()
+        self._buffers = OrderedDict()
+        self._non_persistable_buffer_names_set = set()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._hook_id = 0
+
+    # -- construction -------------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        value = init(shape, dtype)
+        return Parameter(value, name=attr.name, trainable=attr.trainable,
+                         regularizer=attr.regularizer,
+                         learning_rate=attr.learning_rate,
+                         need_clip=attr.need_clip)
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names_set.add(name)
+        else:
+            self._non_persistable_buffer_names_set.discard(name)
+        return tensor
+
+    def create_tensor(self, name=None, dtype=None, default_initializer=None):
+        init = default_initializer or I.Constant(0.0)
+        return Tensor(init([1], dtype or self._dtype))
+
+    # -- attribute routing --------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning params")
+            params[name] = value
+            layers.pop(name, None) if layers else None
+            return
+        if isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning layers")
+            layers[name] = value
+            params.pop(name, None) if params else None
+            return
+        if params and name in params:
+            if value is None:
+                params[name] = None
+                return
+            if isinstance(value, Tensor):
+                params[name].set_value(value)
+                return
+            raise TypeError(f"cannot assign {type(value)} to parameter {name}")
+        if buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                buffers[name].set_value(value)
+            return
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) \
+            + list(self._sub_layers) + list(self._buffers)
+
+    # -- iteration ----------------------------------------------------------
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._walk(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self._walk(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+
+    def _walk(self, prefix="", include_sublayers=True):
+        yield prefix, self
+        if include_sublayers:
+            for lname, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{lname}" if prefix else lname
+                yield from sub._walk(sub_prefix, True)
+
+    def children(self):
+        for _, sub in self.named_children():
+            yield sub
+
+    def named_children(self):
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def sublayers(self, include_self=False):
+        out = []
+        for name, layer in self._walk("", True):
+            if name == "" and not include_self:
+                continue
+            out.append(layer)
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        for name, layer in self._walk(prefix, True):
+            if name == prefix and not include_self:
+                continue
+            yield name, layer
+
+    def apply(self, fn):
+        for sub in self.sublayers(include_self=True):
+            fn(sub)
+        return self
+
+    # -- mode ---------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, out)
+            if result is not None:
+                out = result
+        return out
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, include_sublayers=True, use_hook=True):
+        out = OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            out[name] = p
+        for name, layer in self._walk("", include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or bname in layer._non_persistable_buffer_names_set:
+                    continue
+                out[f"{name}.{bname}" if name else bname] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                arr = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+                if tuple(arr.shape) != target.shape:
+                    raise ValueError(
+                        f"shape mismatch for {name}: checkpoint {arr.shape} "
+                        f"vs model {target.shape}")
+                target.set_value(arr.astype(target.dtype))
+                if isinstance(target, Parameter):
+                    target.stop_gradient = not target.trainable
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        if missing:
+            warnings.warn(f"missing keys in state_dict: {missing}")
+        if unexpected:
+            warnings.warn(f"unexpected keys in state_dict: {unexpected}")
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- functional extraction (the jit bridge) -----------------------------
+    def functional_state(self):
+        """Return ({param_name: value}, {buffer_name: value}) raw pytrees."""
+        params = {n: p._value for n, p in self.named_parameters()}
+        bufs = {n: b._value for n, b in self.named_buffers()}
+        return params, bufs
+
+    def load_functional_state(self, params=None, buffers=None):
+        """Seat raw values (possibly tracers) into params/buffers in place."""
+        if params is not None:
+            for n, p in self.named_parameters():
+                if n in params:
+                    p._value = params[n]
+                    p._node = None
+        if buffers is not None:
+            for n, b in self.named_buffers():
+                if n in buffers:
+                    b._value = buffers[n]
+                    b._node = None
+        return self
+
+    # -- dtype/device sugar -------------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            jd = convert_dtype(dtype)
+            for p in self.parameters():
+                p._value = p._value.astype(jd)
+            for b in self.buffers():
+                if np.issubdtype(b.dtype, np.floating):
+                    b._value = b._value.astype(jd)
+            self._dtype = jd
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def full_name(self):
+        return self._full_name
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [f"{type(self).__name__}({extra}"]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {sub_repr}")
+        return "\n".join(lines) + ")" if len(lines) > 1 else lines[0] + ")"

@@ -1,0 +1,92 @@
+"""Pooling layers (reference python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["MaxPool1D", "MaxPool2D", "AvgPool1D", "AvgPool2D",
+           "AdaptiveAvgPool2D", "AdaptiveMaxPool2D", "AvgPool3D", "MaxPool3D"]
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = dict(kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+    def forward(self, x):
+        return F.max_pool2d(x, **self.args)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.args = dict(kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive, data_format=data_format)
+
+    def forward(self, x):
+        return F.avg_pool2d(x, **self.args)
+
+
+class MaxPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.args = dict(kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        return F.max_pool1d(x, **self.args)
+
+
+class AvgPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+        self.exclusive = exclusive
+
+    def forward(self, x):
+        from ... import ops
+        x4 = ops.unsqueeze(x, 2)
+        out = F.avg_pool2d(x4, (1, self.kernel_size),
+                           stride=(1, self.stride or self.kernel_size),
+                           padding=(0, self.padding), ceil_mode=self.ceil_mode,
+                           exclusive=self.exclusive)
+        return ops.squeeze(out, 2)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, *a, **k):
+        raise NotImplementedError("AvgPool3D: planned")
+
+
+class MaxPool3D(Layer):
+    def __init__(self, *a, **k):
+        raise NotImplementedError("MaxPool3D: planned")
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)

@@ -1,0 +1,344 @@
+"""Recurrent layers.
+
+Analog of reference python/paddle/nn/layer/rnn.py (RNNCellBase, SimpleRNN,
+LSTM, GRU) backed by operators/cudnn_lstm_op.cu / rnn_op. TPU design delta:
+the time loop is a `lax.scan`, which XLA compiles into a single fused loop
+with the gate matmuls batched on the MXU — the analog of cuDNN's fused RNN
+kernels. No dynamic LoD: variable-length sequences use `sequence_length`
+masking over a dense [batch, time, ...] layout.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import ops
+from ...ops._dispatch import defop
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU", "BiRNN"]
+
+
+# -- fused scan kernels ------------------------------------------------------
+
+@defop
+def _rnn_scan_tanh(x, h0, wi, wh, bi, bh, mask):
+    def step(h, inp):
+        xt, mt = inp
+        nh = jnp.tanh(xt @ wi.T + h @ wh.T + bi + bh)
+        nh = jnp.where(mt[:, None], nh, h)
+        return nh, nh
+    hT, hs = jax.lax.scan(step, h0, (jnp.swapaxes(x, 0, 1), mask.T))
+    return jnp.swapaxes(hs, 0, 1), hT
+
+
+@defop
+def _lstm_scan(x, h0, c0, wi, wh, bi, bh, mask):
+    H = h0.shape[-1]
+
+    def step(carry, inp):
+        h, c = carry
+        xt, mt = inp
+        gates = xt @ wi.T + h @ wh.T + bi + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        nc = f * c + i * g
+        nh = o * jnp.tanh(nc)
+        m = mt[:, None]
+        nh = jnp.where(m, nh, h)
+        nc = jnp.where(m, nc, c)
+        return (nh, nc), nh
+
+    (hT, cT), hs = jax.lax.scan(step, (h0, c0),
+                                (jnp.swapaxes(x, 0, 1), mask.T))
+    return jnp.swapaxes(hs, 0, 1), hT, cT
+
+
+@defop
+def _gru_scan(x, h0, wi, wh, bi, bh, mask):
+    def step(h, inp):
+        xt, mt = inp
+        xg = xt @ wi.T + bi
+        hg = h @ wh.T + bh
+        xr, xz, xn = jnp.split(xg, 3, axis=-1)
+        hr, hz, hn = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        n = jnp.tanh(xn + r * hn)
+        nh = (1.0 - z) * n + z * h
+        nh = jnp.where(mt[:, None], nh, h)
+        return nh, nh
+    hT, hs = jax.lax.scan(step, h0, (jnp.swapaxes(x, 0, 1), mask.T))
+    return jnp.swapaxes(hs, 0, 1), hT
+
+
+# -- cells -------------------------------------------------------------------
+
+class RNNCellBase(Layer):
+    def _init_weights(self, input_size, hidden_size, gates, weight_ih_attr,
+                      weight_hh_attr, bias_ih_attr, bias_hh_attr):
+        std = 1.0 / np.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [gates * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [gates * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=u)
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+
+    def get_initial_states(self, batch_size, dtype="float32"):
+        return ops.zeros([batch_size, self.hidden_size], dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.activation = activation
+        self._init_weights(input_size, hidden_size, 1, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self.get_initial_states(inputs.shape[0])
+        z = (ops.matmul(inputs, self.weight_ih, transpose_y=True)
+             + ops.matmul(h, self.weight_hh, transpose_y=True)
+             + self.bias_ih + self.bias_hh)
+        nh = ops.tanh(z) if self.activation == "tanh" else F.relu(z)
+        return nh, nh
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self._init_weights(input_size, hidden_size, 4, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            b = inputs.shape[0]
+            states = (self.get_initial_states(b), self.get_initial_states(b))
+        h, c = states
+        gates = (ops.matmul(inputs, self.weight_ih, transpose_y=True)
+                 + ops.matmul(h, self.weight_hh, transpose_y=True)
+                 + self.bias_ih + self.bias_hh)
+        i, f, g, o = ops.split(gates, 4, axis=-1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = ops.tanh(g)
+        nc = f * c + i * g
+        nh = o * ops.tanh(nc)
+        return nh, (nh, nc)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self._init_weights(input_size, hidden_size, 3, weight_ih_attr,
+                           weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else self.get_initial_states(inputs.shape[0])
+        xg = ops.matmul(inputs, self.weight_ih, transpose_y=True) + self.bias_ih
+        hg = ops.matmul(h, self.weight_hh, transpose_y=True) + self.bias_hh
+        xr, xz, xn = ops.split(xg, 3, axis=-1)
+        hr, hz, hn = ops.split(hg, 3, axis=-1)
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        n = ops.tanh(xn + r * hn)
+        nh = (1.0 - z) * n + z * h
+        return nh, nh
+
+
+# -- multi-layer wrappers ----------------------------------------------------
+
+class _RNNBase(Layer):
+    MODE = None  # "RNN_TANH" | "LSTM" | "GRU"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirect else 1
+        gates = {"RNN_TANH": 1, "LSTM": 4, "GRU": 3}[self.MODE]
+        std = 1.0 / np.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 \
+                    else hidden_size * self.num_directions
+                sfx = f"{layer}" + ("_reverse" if d else "")
+                self.add_parameter(f"weight_ih_l{sfx}", self.create_parameter(
+                    [gates * hidden_size, in_sz], default_initializer=u))
+                self.add_parameter(f"weight_hh_l{sfx}", self.create_parameter(
+                    [gates * hidden_size, hidden_size], default_initializer=u))
+                self.add_parameter(f"bias_ih_l{sfx}", self.create_parameter(
+                    [gates * hidden_size], is_bias=True, default_initializer=u))
+                self.add_parameter(f"bias_hh_l{sfx}", self.create_parameter(
+                    [gates * hidden_size], is_bias=True, default_initializer=u))
+
+    def _scan(self, x, init, wi, wh, bi, bh, mask):
+        if self.MODE == "LSTM":
+            out, hT, cT = _lstm_scan(x, init[0], init[1], wi, wh, bi, bh, mask)
+            return out, (hT, cT)
+        if self.MODE == "GRU":
+            out, hT = _gru_scan(x, init, wi, wh, bi, bh, mask)
+            return out, hT
+        out, hT = _rnn_scan_tanh(x, init, wi, wh, bi, bh, mask)
+        return out, hT
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        if self.time_major:
+            x = ops.transpose(x, [1, 0, 2])
+        b, t = x.shape[0], x.shape[1]
+        if sequence_length is not None:
+            mask = F.sequence_mask(sequence_length, maxlen=t, dtype="bool")
+        else:
+            mask = ops.ones([b, t], "bool")
+
+        def zeros():
+            return ops.zeros([b, self.hidden_size], "float32")
+
+        is_lstm = self.MODE == "LSTM"
+        n_states = self.num_layers * self.num_directions
+        if initial_states is None:
+            if is_lstm:
+                init_h = [zeros() for _ in range(n_states)]
+                init_c = [zeros() for _ in range(n_states)]
+            else:
+                init_h = [zeros() for _ in range(n_states)]
+        else:
+            if is_lstm:
+                h0, c0 = initial_states
+                init_h = ops.unbind(h0, 0)
+                init_c = ops.unbind(c0, 0)
+            else:
+                init_h = ops.unbind(initial_states, 0)
+
+        final_h, final_c = [], []
+        out = x
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(self.num_directions):
+                sfx = f"{layer}" + ("_reverse" if d else "")
+                wi = getattr(self, f"weight_ih_l{sfx}")
+                wh = getattr(self, f"weight_hh_l{sfx}")
+                bi = getattr(self, f"bias_ih_l{sfx}")
+                bh = getattr(self, f"bias_hh_l{sfx}")
+                idx = layer * self.num_directions + d
+                seq = ops.flip(out, [1]) if d else out
+                m = ops.flip(mask, [1]) if d else mask
+                init = (init_h[idx], init_c[idx]) if is_lstm else init_h[idx]
+                o, hT = self._scan(seq, init, wi, wh, bi, bh, m)
+                if d:
+                    o = ops.flip(o, [1])
+                outs.append(o)
+                if is_lstm:
+                    final_h.append(hT[0])
+                    final_c.append(hT[1])
+                else:
+                    final_h.append(hT)
+            out = ops.concat(outs, axis=-1) if len(outs) > 1 else outs[0]
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                out = F.dropout(out, p=self.dropout, training=self.training)
+
+        if self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        h_stack = ops.stack(final_h, axis=0)
+        if is_lstm:
+            c_stack = ops.stack(final_c, axis=0)
+            return out, (h_stack, c_stack)
+        return out, h_stack
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+def _masked_state(m, new, old):
+    """Freeze state past each sequence's end (per-timestep select)."""
+    if isinstance(new, (tuple, list)):
+        return type(new)(_masked_state(m, n, o) for n, o in zip(new, old))
+    return new * m + old * (1.0 - m)
+
+
+class RNN(Layer):
+    """Generic cell-driven RNN wrapper (reference nn/layer/rnn.py RNN):
+    runs any cell over time with a python loop traced into the step graph."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        if self.time_major:
+            x = ops.transpose(x, [1, 0, 2])
+        t = x.shape[1]
+        mask = None
+        if sequence_length is not None:
+            from .. import functional as F
+            mask = F.sequence_mask(sequence_length, maxlen=t, dtype="float32")
+        steps = range(t - 1, -1, -1) if self.is_reverse else range(t)
+        state = initial_states
+        outs = [None] * t
+        for i in steps:
+            o, new_state = self.cell(x[:, i], state)
+            if mask is not None and state is not None:
+                m = ops.unsqueeze(mask[:, i], -1)
+                o = o * m  # zero outputs past each sequence's end
+                new_state = _masked_state(m, new_state, state)
+            outs[i] = o
+            state = new_state
+        out = ops.stack(outs, axis=1)
+        if self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        return out, state
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        sf = sb = None
+        if initial_states is not None:
+            sf, sb = initial_states
+        of, sf = self.fw(inputs, sf)
+        ob, sb = self.bw(inputs, sb)
+        return ops.concat([of, ob], axis=-1), (sf, sb)

@@ -64,22 +64,30 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
     loss = -jnp.sum(soft * logp, axis=axis)
     lbl1 = (jnp.squeeze(label, axis) if not soft_label and label.ndim == input.ndim
             else label)
-    if weight is not None and not soft_label:
-        loss = loss * jnp.take(weight, jnp.where(lbl1 == ignore_index, 0, lbl1))
     if not soft_label:
         valid = (lbl1 != ignore_index)
-        loss = jnp.where(valid, loss, 0.0)
+        # mean normalizes by the sum of selected weights over valid samples
+        # (reference softmax_with_cross_entropy + weighted NLL semantics)
+        w = (jnp.take(weight, jnp.where(valid, lbl1, 0))
+             if weight is not None else jnp.ones_like(loss))
+        w = jnp.where(valid, w, 0.0)
+        loss = loss * w
         if reduction == "mean":
-            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
     return _reduce(loss, reduction)
 
 
 @defop
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):  # noqa: A002
-    picked = -jnp.take_along_axis(input, label[..., None].astype(jnp.int32),
+    valid = label != ignore_index
+    safe = jnp.where(valid, label, 0)
+    picked = -jnp.take_along_axis(input, safe[..., None].astype(jnp.int32),
                                   axis=-1)[..., 0]
-    if weight is not None:
-        picked = picked * jnp.take(weight, label)
+    w = jnp.take(weight, safe) if weight is not None else jnp.ones_like(picked)
+    w = jnp.where(valid, w, 0.0)
+    picked = picked * w
+    if reduction == "mean":
+        return jnp.sum(picked) / jnp.maximum(jnp.sum(w), 1e-12)
     return _reduce(picked, reduction)
 
 

@@ -132,7 +132,9 @@ def embedding(weight, ids, padding_idx=None, sparse=False):
     # same dense gather on TPU: SelectedRows grads have no XLA analog, the
     # gather's scatter-add transpose is already the efficient form.
     out = jnp.take(weight, ids, axis=0)
-    if padding_idx is not None and padding_idx >= 0:
+    if padding_idx is not None:
+        if padding_idx < 0:  # paddle normalizes negative indices
+            padding_idx = weight.shape[0] + padding_idx
         mask = (ids != padding_idx)[..., None].astype(out.dtype)
         out = out * mask
     return out

@@ -1,0 +1,65 @@
+"""Gradient clipping.
+
+Analog of reference python/paddle/fluid/clip.py (ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm). Clips operate on raw grad pytrees so
+they fuse into the jitted optimizer step (the reference appends clip ops to
+the program; here XLA fuses the global-norm reduction with the updates).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm",
+           "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def apply(self, grads_dict, params_meta=None):
+        """grads_dict: {name: raw grad array} -> clipped dict."""
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        # paddle-style [(param, grad)] interface
+        from ..core.tensor import Tensor
+        names = [str(i) for i in range(len(params_grads))]
+        gd = {n: g._value for n, (_, g) in zip(names, params_grads)}
+        out = self.apply(gd)
+        return [(p, Tensor(out[n], stop_gradient=True, _internal=True))
+                for n, (p, _) in zip(names, params_grads)]
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def apply(self, grads, params_meta=None):
+        return {k: jnp.clip(g, self.min, self.max) for k, g in grads.items()}
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, grads, params_meta=None):
+        out = {}
+        for k, g in grads.items():
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.where(norm > self.clip_norm,
+                              self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out[k] = g * scale.astype(g.dtype)
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, grads, params_meta=None):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in grads.values())
+        global_norm = jnp.sqrt(sq)
+        scale = jnp.where(global_norm > self.clip_norm,
+                          self.clip_norm / jnp.maximum(global_norm, 1e-12),
+                          1.0)
+        return {k: (g * scale).astype(g.dtype) for k, g in grads.items()}

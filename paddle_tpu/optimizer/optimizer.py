@@ -1,0 +1,482 @@
+"""Optimizers.
+
+Analog of reference python/paddle/optimizer/ (optimizer.py Optimizer base,
+adam.py, adamw.py, momentum.py, ...) backed by operators/optimizers/* CUDA
+kernels (17 families: sgd, momentum+lars, adam/adamw/adamax/lamb,
+adagrad/adadelta/rmsprop, ...).
+
+TPU design delta (SURVEY.md §7): the whole update — regularizer terms, grad
+clip, every parameter's rule — is ONE pure function over (params, grads,
+slots, lr, t) pytrees, jitted with buffer donation. XLA fuses it into a few
+kernels, which is the analog of the reference's fuse_optimizer_ops_pass
+(ir/fuse_optimizer_ops_pass/) and fused_adam. The same pure function embeds
+directly into hapi/static whole-step programs.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core import tape as _tape
+from . import lr as lr_mod
+from .clip import ClipGradBase
+from ..regularizer import L1Decay, L2Decay
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=False):
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float):
+            weight_decay = L2Decay(weight_decay)
+        self._weight_decay = weight_decay
+        self._multi_precision = multi_precision
+        self._slots: Dict[str, Dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+        self._step_fn = None
+        self._step_fn_sig = None
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, lr_mod.LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, lr_mod.LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        lr = self._learning_rate
+        return lr if isinstance(lr, lr_mod.LRScheduler) else None
+
+    # -- slots ---------------------------------------------------------------
+    @staticmethod
+    def _slot_like(v):
+        """Moment buffers stay float32 even for bf16/f16 params — reduced-
+        precision moments diverge (the reference's multi_precision /
+        master-weight path in adam_op.cu serves the same purpose)."""
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            return jnp.zeros(v.shape, jnp.float32)
+        return jnp.zeros_like(v)
+
+    def _init_slots_for(self, name: str, value) -> dict:
+        """Per-parameter optimizer state; override per optimizer."""
+        return {}
+
+    def _ensure_slots(self, params: Dict[str, jnp.ndarray]):
+        for name, v in params.items():
+            if name not in self._slots:
+                self._slots[name] = self._init_slots_for(name, v)
+
+    # -- the pure update (embeddable in any jitted program) ------------------
+    def _rule(self, p, g, slots, lr, t):
+        raise NotImplementedError
+
+    def apply_gradients_pure(self, params, grads, slots, lr, t, param_meta=None):
+        """Pure: (params, grads, slots, lr_scalar, step) -> (new_params, new_slots).
+
+        param_meta: {name: {"lr_ratio": float, "regularizer": obj|None,
+                            "need_clip": bool}}
+        """
+        param_meta = param_meta or {}
+        # 1) regularizer terms (reference: regularizer.py append_regularization_ops)
+        reg_grads = {}
+        for k, g in grads.items():
+            meta = param_meta.get(k, {})
+            reg = meta.get("regularizer", self._coupled_decay_default())
+            if reg is not None:
+                g = g + reg.grad_term(params[k]).astype(g.dtype)
+            reg_grads[k] = g
+        # 2) clip (reference: clip.py _append_clip_op)
+        if self._grad_clip is not None:
+            clippable = {k: g for k, g in reg_grads.items()
+                         if param_meta.get(k, {}).get("need_clip", True)}
+            clipped = self._grad_clip.apply(clippable)
+            reg_grads.update(clipped)
+        # 3) per-param rule
+        new_params, new_slots = {}, {}
+        for k, p in params.items():
+            g = reg_grads[k]
+            lr_k = lr * param_meta.get(k, {}).get("lr_ratio", 1.0)
+            np_, ns = self._rule(p, g.astype(p.dtype), self._slots_of(slots, k),
+                                 lr_k, t)
+            new_params[k] = np_
+            new_slots[k] = ns
+        return new_params, new_slots
+
+    def _coupled_decay_default(self):
+        return self._weight_decay
+
+    @staticmethod
+    def _slots_of(slots, k):
+        return slots.get(k, {})
+
+    # -- eager step ----------------------------------------------------------
+    def _collect(self):
+        if self._parameter_list is None:
+            raise ValueError("optimizer constructed without parameters=")
+        out = OrderedDict()
+        for i, p in enumerate(self._parameter_list):
+            if p.stop_gradient or p.grad is None:
+                continue
+            name = p.name or f"param_{i}"
+            out[name] = p
+        return out
+
+    def _param_meta(self, named):
+        meta = {}
+        for name, p in named.items():
+            meta[name] = {
+                "lr_ratio": getattr(p, "optimize_attr", {}).get("learning_rate", 1.0),
+                "regularizer": getattr(p, "regularizer", None) or self._coupled_decay_default(),
+                "need_clip": getattr(p, "need_clip", True),
+            }
+        return meta
+
+    def _get_step_fn(self, named):
+        sig = tuple(sorted(named))
+        if self._step_fn is None or self._step_fn_sig != sig:
+            meta = self._param_meta(named)
+
+            def step_fn(params, grads, slots, lr, t):
+                return self.apply_gradients_pure(params, grads, slots, lr, t,
+                                                 param_meta=meta)
+
+            self._step_fn = jax.jit(step_fn, donate_argnums=(0, 2))
+            self._step_fn_sig = sig
+        return self._step_fn
+
+    @_tape.no_grad()
+    def step(self):
+        named = self._collect()
+        if not named:
+            return
+        params = {k: p._value for k, p in named.items()}
+        grads = {k: p.grad._value for k, p in named.items()}
+        self._ensure_slots(params)
+        slots = {k: self._slots[k] for k in named}
+        self._step_count += 1
+        fn = self._get_step_fn(named)
+        new_params, new_slots = fn(params, grads, slots,
+                                   jnp.asarray(self.get_lr(), jnp.float32),
+                                   jnp.asarray(self._step_count, jnp.int32))
+        for k, p in named.items():
+            p._value = new_params[k]
+            p._node = None
+        self._slots.update(new_slots)
+
+    def clear_grad(self, set_to_zero=False):
+        if self._parameter_list is not None:
+            for p in self._parameter_list:
+                p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Dygraph: backward + step (reference optimizer.py minimize)."""
+        loss.backward()
+        self.step()
+        return [], []
+
+    # -- state ---------------------------------------------------------------
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        for pname, slots in self._slots.items():
+            for sname, v in slots.items():
+                out[f"{pname}/{sname}"] = np.asarray(v)
+        sched = self._lr_scheduler
+        if sched is not None:
+            out["LR_Scheduler"] = sched.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("_step_count", 0))
+        sched = self._lr_scheduler
+        if sched is not None and "LR_Scheduler" in state:
+            sched.set_state_dict(state["LR_Scheduler"])
+        for key, v in state.items():
+            if key in ("_step_count", "LR_Scheduler"):
+                continue
+            if "/" not in key:
+                continue
+            pname, sname = key.rsplit("/", 1)
+            arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+            self._slots.setdefault(pname, {})[sname] = jnp.asarray(arr)
+        # force step fn rebuild (slot structure may have changed)
+        self._step_fn = None
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    """reference: operators/optimizers/sgd_op.cc"""
+
+    def _rule(self, p, g, slots, lr, t):
+        return p - lr.astype(p.dtype) * g, {}
+
+
+class Momentum(Optimizer):
+    """reference: operators/optimizers/momentum_op.cc (use_nesterov attr)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_slots_for(self, name, v):
+        return {"velocity": self._slot_like(v)}
+
+    def _rule(self, p, g, slots, lr, t):
+        g32 = g.astype(jnp.float32)
+        v = self._momentum * slots["velocity"] + g32
+        if self._nesterov:
+            upd = lr * (g32 + self._momentum * v)
+        else:
+            upd = lr * v
+        new_p = (p.astype(jnp.float32) - upd).astype(p.dtype)
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference: operators/optimizers/adam_op.cc (+ fused/fused_adam)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, name=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_slots_for(self, name, v):
+        return {"moment1": self._slot_like(v), "moment2": self._slot_like(v)}
+
+    def _rule(self, p, g, slots, lr, t):
+        # moment math in f32 regardless of param dtype (bf16-safe)
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        tf = t.astype(jnp.float32)
+        bc1 = 1 - jnp.power(jnp.float32(self._beta1), tf)
+        bc2 = 1 - jnp.power(jnp.float32(self._beta2), tf)
+        step_size = lr * jnp.sqrt(bc2) / bc1
+        upd = step_size * m / (jnp.sqrt(v) + self._epsilon)
+        new_p = (p.astype(jnp.float32) - upd).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py —
+    decay applied to the parameter, not through the moments)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 grad_clip=None, lazy_mode=False, apply_decay_param_fun=None,
+                 name=None, multi_precision=False, lr_ratio=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, name, multi_precision)
+        self._decoupled_wd = weight_decay if isinstance(weight_decay, float) \
+            else getattr(weight_decay, "coeff", 0.0)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _coupled_decay_default(self):
+        return None  # decay is decoupled
+
+    def apply_gradients_pure(self, params, grads, slots, lr, t,
+                             param_meta=None):
+        new_params, new_slots = super().apply_gradients_pure(
+            params, grads, slots, lr, t, param_meta)
+        wd = self._decoupled_wd
+        if wd:
+            for k in new_params:
+                if (self._apply_decay_param_fun is not None
+                        and not self._apply_decay_param_fun(k)):
+                    continue
+                p = params[k]
+                new_params[k] = new_params[k] - (lr * wd).astype(p.dtype) * p
+        return new_params, new_slots
+
+
+class Adamax(Optimizer):
+    """reference: operators/optimizers/adamax_op.cc"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_slots_for(self, name, v):
+        return {"moment": self._slot_like(v), "inf_norm": self._slot_like(v)}
+
+    def _rule(self, p, g, slots, lr, t):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g32))
+        bc1 = 1 - jnp.power(jnp.float32(self._beta1), t.astype(jnp.float32))
+        upd = (lr / bc1) * m / (u + self._epsilon)
+        new_p = (p.astype(jnp.float32) - upd).astype(p.dtype)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    """reference: operators/optimizers/adagrad_op.cc"""
+
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_slots_for(self, name, v):
+        return {"moment": jnp.full_like(v, self._init_acc)}
+
+    def _rule(self, p, g, slots, lr, t):
+        g32 = g.astype(jnp.float32)
+        acc = slots["moment"] + jnp.square(g32)
+        upd = lr * g32 / (jnp.sqrt(acc) + self._epsilon)
+        new_p = (p.astype(jnp.float32) - upd).astype(p.dtype)
+        return new_p, {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    """reference: operators/optimizers/adadelta_op.cc"""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_slots_for(self, name, v):
+        return {"avg_squared_grad": self._slot_like(v),
+                "avg_squared_update": self._slot_like(v)}
+
+    def _rule(self, p, g, slots, lr, t):
+        rho = self._rho
+        eps = self._epsilon
+        g32 = g.astype(jnp.float32)
+        asg = rho * slots["avg_squared_grad"] + (1 - rho) * jnp.square(g32)
+        update = -jnp.sqrt((slots["avg_squared_update"] + eps)
+                           / (asg + eps)) * g32
+        asu = rho * slots["avg_squared_update"] + (1 - rho) * jnp.square(update)
+        new_p = (p.astype(jnp.float32) + lr * update).astype(p.dtype)
+        return new_p, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    """reference: operators/optimizers/rmsprop_op.cc (centered variant)."""
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_slots_for(self, name, v):
+        s = {"mean_square": self._slot_like(v),
+             "momentum": self._slot_like(v)}
+        if self._centered:
+            s["mean_grad"] = self._slot_like(v)
+        return s
+
+    def _rule(self, p, g, slots, lr, t):
+        rho = self._rho
+        g32 = g.astype(jnp.float32)
+        ms = rho * slots["mean_square"] + (1 - rho) * jnp.square(g32)
+        out_slots = {"mean_square": ms}
+        if self._centered:
+            mg = rho * slots["mean_grad"] + (1 - rho) * g32
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            out_slots["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum"] + lr * g32 / denom
+        out_slots["momentum"] = mom
+        new_p = (p.astype(jnp.float32) - mom).astype(p.dtype)
+        return new_p, out_slots
+
+
+class Lamb(Optimizer):
+    """reference: operators/optimizers/lamb_op.cc (layer-adaptive Adam)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-06, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_slots_for(self, name, v):
+        return {"moment1": self._slot_like(v), "moment2": self._slot_like(v)}
+
+    def _rule(self, p, g, slots, lr, t):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(jnp.float32(self._beta1), tf))
+        vhat = v / (1 - jnp.power(jnp.float32(self._beta2), tf))
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._wd * p32
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = (p32 - lr * trust * r).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class Lars(Momentum):
+    """LARS momentum (reference: operators/optimizers/lars_momentum_op.cc)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip, name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+
+    def _rule(self, p, g, slots, lr, t):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(p32)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + self._lars_wd * w_norm + 1e-12),
+            1.0)
+        eff = g32 + self._lars_wd * p32
+        v = self._momentum * slots["velocity"] + lr * local_lr * eff
+        new_p = (p32 - v).astype(p.dtype)
+        return new_p, {"velocity": v}

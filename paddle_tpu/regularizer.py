@@ -1,0 +1,23 @@
+"""Weight-decay regularizers (reference python/paddle/fluid/regularizer.py:
+L1DecayRegularizer / L2DecayRegularizer appended as grad-modifying ops)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def grad_term(self, param_value):
+        return self.coeff * jnp.sign(param_value)
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def grad_term(self, param_value):
+        return self.coeff * param_value

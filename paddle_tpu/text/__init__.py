@@ -1,0 +1,10 @@
+"""paddle.text — NLP models and datasets (reference python/paddle/text/).
+
+The reference ships dataset wrappers (Imdb, Conll05, WMT14...) and leaves
+models to downstream repos; here the flagship pretraining models
+(BERT-family) are first-class since they are the perf north star
+(BASELINE.md config 3).
+"""
+from . import datasets  # noqa: F401
+from .models import Bert, BertConfig, GPT, GPTConfig  # noqa: F401
+from . import models  # noqa: F401

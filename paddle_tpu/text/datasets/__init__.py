@@ -1,0 +1,71 @@
+"""Text datasets (reference python/paddle/text/datasets/: Imdb, Conll05,
+Movielens, UCIHousing, WMT14/16...). Zero-egress fallback: synthetic token
+streams with Zipfian statistics for LM pretraining benches.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["LMDataset", "UCIHousing", "Imdb"]
+
+
+class LMDataset(Dataset):
+    """Synthetic masked/causal LM pretraining data (deterministic)."""
+
+    def __init__(self, vocab_size=30522, seq_len=128, n=4096, mode="mlm",
+                 mask_prob=0.15, seed=0):
+        rng = np.random.RandomState(seed)
+        # Zipfian token distribution, like natural text
+        ranks = np.arange(1, vocab_size - 4)
+        probs = 1.0 / ranks
+        probs /= probs.sum()
+        self.tokens = (rng.choice(ranks, size=(n, seq_len), p=probs) + 4) \
+            .astype("int64")
+        self.mode = mode
+        self.vocab_size = vocab_size
+        if mode == "mlm":
+            mask = rng.rand(n, seq_len) < mask_prob
+            self.labels = np.where(mask, self.tokens, -100).astype("int64")
+            self.inputs = np.where(mask, 3, self.tokens).astype("int64")  # [MASK]=3
+        else:  # causal
+            self.inputs = self.tokens[:, :-1]
+            self.labels = self.tokens[:, 1:]
+
+    def __getitem__(self, idx):
+        return self.inputs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.inputs)
+
+
+class UCIHousing(Dataset):
+    def __init__(self, mode="train"):
+        rng = np.random.RandomState(42)
+        n = 404 if mode == "train" else 102
+        self.x = rng.randn(n, 13).astype("float32")
+        w = rng.randn(13, 1).astype("float32")
+        self.y = (self.x @ w + 0.1 * rng.randn(n, 1)).astype("float32")
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Imdb(Dataset):
+    def __init__(self, mode="train", cutoff=150):
+        rng = np.random.RandomState(9 if mode == "train" else 10)
+        n = 2048 if mode == "train" else 512
+        self.docs = rng.randint(2, 5000, size=(n, 128)).astype("int64")
+        self.labels = rng.randint(0, 2, n).astype("int64")
+        # plant signal: positive docs use low token ids more often
+        self.docs[self.labels == 1] //= 2
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)

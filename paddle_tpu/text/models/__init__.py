@@ -1,0 +1,2 @@
+from .bert import Bert, BertConfig  # noqa: F401
+from .gpt import GPT, GPTConfig  # noqa: F401

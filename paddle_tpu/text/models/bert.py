@@ -1,0 +1,156 @@
+"""BERT (encoder-only pretraining model) — the flagship benchmark model.
+
+Parity target: the reference builds BERT from paddle.nn.Transformer pieces
+(python/paddle/nn/layer/transformer.py:85 MultiHeadAttention,
+:575 TransformerEncoder) — BASELINE.md config 3 ("BERT-base pretrain").
+This module provides the assembled model the reference leaves to downstream
+repos, with MLM + NSP heads, weight-tied decoder, and a `bert_base` config
+matching the standard 110M-parameter recipe.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ... import nn, ops
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def bert_base():
+        return BertConfig()
+
+    @staticmethod
+    def bert_large():
+        return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                          num_attention_heads=16, intermediate_size=4096)
+
+    @staticmethod
+    def tiny():
+        return BertConfig(vocab_size=1024, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=128, max_position_embeddings=128)
+
+
+def _bert_init(root: nn.Layer, std=0.02):
+    """Standard BERT init: N(0, 0.02) matrices/embeddings, zero biases,
+    unit LayerNorm — keeps tied-decoder logits O(1) at step 0."""
+    from ...nn import initializer as I
+    for name, p in root.named_parameters():
+        if p.ndim >= 2:
+            p.set_value(I.TruncatedNormal(0.0, std)(p.shape, "float32"))
+        elif "weight" in name and p.ndim == 1:  # LayerNorm scale
+            p.set_value(I.Constant(1.0)(p.shape, "float32"))
+        else:
+            p.set_value(I.Constant(0.0)(p.shape, "float32"))
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq_len = input_ids.shape[1]
+        pos = ops.arange(seq_len, dtype="int64")
+        emb = self.word_embeddings(input_ids)
+        emb = emb + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, hidden_size):
+        super().__init__()
+        self.dense = nn.Linear(hidden_size, hidden_size)
+
+    def forward(self, hidden_states):
+        return ops.tanh(self.dense(hidden_states[:, 0]))
+
+
+class Bert(nn.Layer):
+    """Encoder + MLM head (tied to word embeddings) + NSP head."""
+
+    def __init__(self, config: BertConfig = None, with_mlm=True,
+                 with_nsp=False):
+        super().__init__()
+        cfg = config or BertConfig.bert_base()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            d_model=cfg.hidden_size, nhead=cfg.num_attention_heads,
+            dim_feedforward=cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob)
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = BertPooler(cfg.hidden_size)
+        self.with_mlm = with_mlm
+        self.with_nsp = with_nsp
+        if with_mlm:
+            self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+            self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
+                                         epsilon=cfg.layer_norm_eps)
+            self.mlm_bias = self.create_parameter(
+                [cfg.vocab_size], is_bias=True)
+        if with_nsp:
+            self.nsp_head = nn.Linear(cfg.hidden_size, 2)
+        _bert_init(self, std=0.02)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            # [b, s] 1/0 -> additive [b, 1, 1, s]
+            m = ops.unsqueeze(attention_mask.astype("float32"), [1, 2])
+            mask = (1.0 - m) * -1e9
+        h = self.encoder(x, src_mask=mask)
+        outputs = []
+        if self.with_mlm:
+            t = ops.gelu(self.mlm_transform(h))
+            t = self.mlm_norm(t)
+            # weight-tied decoder: [b,s,H] @ [V,H]^T
+            logits = ops.matmul(t, self.embeddings.word_embeddings.weight,
+                                transpose_y=True) + self.mlm_bias
+            outputs.append(logits)
+        if self.with_nsp:
+            outputs.append(self.nsp_head(self.pooler(h)))
+        if not outputs:
+            return h
+        return outputs[0] if len(outputs) == 1 else tuple(outputs)
+
+    def num_params(self):
+        return sum(p.size for p in self.parameters())
+
+
+class BertPretrainingCriterion(nn.Layer):
+    """MLM (+ optional NSP) loss with ignore_index=-100 masking."""
+
+    def __init__(self, vocab_size):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.ce = nn.CrossEntropyLoss(ignore_index=-100)
+
+    def forward(self, prediction_scores, masked_lm_labels):
+        b, s, v = prediction_scores.shape
+        return self.ce(ops.reshape(prediction_scores, [b * s, v]),
+                       ops.reshape(masked_lm_labels, [b * s]))

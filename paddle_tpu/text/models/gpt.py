@@ -1,0 +1,71 @@
+"""GPT (decoder-only causal LM) — ERNIE/Transformer-XL-class model-parallel
+workload (BASELINE.md config 5 territory). Built from the same encoder
+blocks with causal masking via the fused attention core.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ... import nn, ops
+from ...nn import functional as F
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_seq_len: int = 1024
+    dropout: float = 0.1
+
+    @staticmethod
+    def tiny():
+        return GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                         num_heads=2, intermediate_size=128, max_seq_len=128)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size)
+        self.attn = nn.MultiHeadAttention(cfg.hidden_size, cfg.num_heads,
+                                          dropout=cfg.dropout)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size)
+        self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, causal_mask):
+        h = self.ln1(x)
+        x = x + self.attn(h, attn_mask=causal_mask)
+        h = self.ln2(x)
+        x = x + self.drop(self.fc2(F.gelu(self.fc1(h))))
+        return x
+
+
+class GPT(nn.Layer):
+    def __init__(self, config: GPTConfig = None):
+        super().__init__()
+        cfg = config or GPTConfig()
+        self.config = cfg
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg)
+                                    for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size)
+        from .bert import _bert_init
+        _bert_init(self, std=0.02)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = ops.arange(s, dtype="int64")
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        mask = nn.Transformer.generate_square_subsequent_mask(s)
+        for blk in self.blocks:
+            x = blk(x, mask)
+        x = self.ln_f(x)
+        # weight-tied LM head
+        return ops.matmul(x, self.wte.weight, transpose_y=True)

@@ -1,0 +1,6 @@
+"""paddle.vision (reference python/paddle/vision/: models, transforms,
+datasets, ops)."""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from .models import LeNet  # noqa: F401

@@ -1,0 +1,166 @@
+"""Vision transforms (reference python/paddle/vision/transforms/transforms.py).
+
+Numpy-based host-side transforms; CHW float32 in/out unless noted.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomHorizontalFlip",
+           "RandomVerticalFlip", "RandomCrop", "CenterCrop", "Transpose",
+           "RandomResizedCrop", "BrightnessTransform", "Pad"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    """HWC uint8 -> CHW float32 in [0,1]."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.dtype == np.uint8:
+            arr = arr.astype("float32") / 255.0
+        if arr.ndim == 2:
+            arr = arr[None]
+        elif arr.ndim == 3 and self.data_format == "CHW" and arr.shape[-1] in (1, 3, 4):
+            arr = arr.transpose(2, 0, 1)
+        return arr.astype("float32")
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype="float32").reshape(-1, 1, 1)
+        self.std = np.asarray(std, dtype="float32").reshape(-1, 1, 1)
+
+    def __call__(self, img):
+        return ((np.asarray(img, dtype="float32") - self.mean)
+                / self.std).astype("float32")
+
+
+def _resize_chw(img, size):
+    c, h, w = img.shape
+    oh, ow = size
+    ri = (np.arange(oh) * h / oh).astype(int).clip(0, h - 1)
+    ci = (np.arange(ow) * w / ow).astype(int).clip(0, w - 1)
+    return img[:, ri][:, :, ci]
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        return _resize_chw(np.asarray(img), self.size)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[..., ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[..., ::-1, :].copy()
+        return img
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if self.padding:
+            p = self.padding
+            img = np.pad(img, [(0, 0), (p, p), (p, p)])
+        c, h, w = img.shape
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return img[:, i:i + th, j:j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        c, h, w = img.shape
+        th, tw = self.size
+        i = (h - th) // 2
+        j = (w - tw) // 2
+        return img[:, i:i + th, j:j + tw]
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        c, h, w = img.shape
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if th <= h and tw <= w:
+                i = np.random.randint(0, h - th + 1)
+                j = np.random.randint(0, w - tw + 1)
+                return _resize_chw(img[:, i:i + th, j:j + tw], self.size)
+        return _resize_chw(img, self.size)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return (np.asarray(img) * alpha).astype("float32")
+
+
+class Pad:
+    def __init__(self, padding, fill=0):
+        self.padding = padding
+        self.fill = fill
+
+    def __call__(self, img):
+        p = self.padding
+        return np.pad(np.asarray(img), [(0, 0), (p, p), (p, p)],
+                      constant_values=self.fill)

@@ -1,0 +1,122 @@
+"""End-to-end Model.fit tests — the 'book tests' analog
+(reference python/paddle/fluid/tests/book/test_recognize_digits.py:
+small model trained a few iterations, loss must drop, save/load roundtrip).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import Model, nn, optimizer
+from paddle_tpu.hapi.callbacks import EarlyStopping, History
+from paddle_tpu.io import DataLoader, TensorDataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def small_mnist(n=512, mode="train"):
+    ds = MNIST(mode=mode)
+    from paddle_tpu.io import Subset
+    return Subset(ds, range(n))
+
+
+def test_model_fit_mnist_lenet():
+    paddle.seed(1)
+    model = Model(LeNet())
+    model.prepare(
+        optimizer=optimizer.Adam(learning_rate=0.001,
+                                 parameters=model.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    hist = History()
+    train = small_mnist(512)
+    model.fit(train, batch_size=64, epochs=2, verbose=0, callbacks=[hist],
+              shuffle=True, drop_last=True)
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0], f"loss did not drop: {losses}"
+    logs = model.evaluate(small_mnist(256, "test"), batch_size=64, verbose=0)
+    assert logs["acc"] > 0.3  # synthetic digits are very separable
+    assert logs["loss"] < 2.5
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    paddle.seed(2)
+    model = Model(LeNet())
+    model.prepare(optimizer=optimizer.Adam(parameters=model.parameters()),
+                  loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    train = small_mnist(128)
+    model.fit(train, batch_size=64, epochs=1, verbose=0)
+    path = str(tmp_path / "ckpt" / "model")
+    model.save(path)
+
+    model2 = Model(LeNet())
+    model2.prepare(optimizer=optimizer.Adam(parameters=model2.parameters()),
+                   loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+    model2.load(path)
+    x = paddle.randn([4, 1, 28, 28])
+    np.testing.assert_allclose(model.predict_batch([x])[0],
+                               model2.predict_batch([x])[0], rtol=1e-5,
+                               atol=1e-6)
+    assert model2._optimizer._step_count == model._optimizer._step_count
+
+
+def test_model_predict_stack():
+    model = Model(LeNet())
+    model.prepare(loss=None)
+    ds = small_mnist(32, "test")
+    outs = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert outs[0].shape == (32, 10)
+
+
+def test_early_stopping_stops():
+    paddle.seed(3)
+    model = Model(nn.Sequential(nn.Flatten(), nn.Linear(784, 10)))
+    model.prepare(optimizer=optimizer.SGD(learning_rate=0.0,
+                                          parameters=model.parameters()),
+                  loss=nn.CrossEntropyLoss())
+    es = EarlyStopping(monitor="loss", patience=1, verbose=0)
+    model.fit(small_mnist(64), batch_size=32, epochs=10, verbose=0,
+              callbacks=[es])
+    assert model.stop_training  # lr=0 -> no improvement -> stops early
+
+
+def test_dataloader_shapes_and_order():
+    X = np.arange(20, dtype="float32").reshape(10, 2)
+    y = np.arange(10, dtype="int64")
+    ds = TensorDataset([X, y])
+    dl = DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert xb.shape == (4, 2)
+    np.testing.assert_array_equal(yb, [0, 1, 2, 3])
+    dl = DataLoader(ds, batch_size=4, drop_last=True)
+    assert len(list(dl)) == 2
+
+
+def test_dataloader_num_workers():
+    X = np.random.rand(64, 3).astype("float32")
+    ds = TensorDataset([X])
+    dl = DataLoader(ds, batch_size=8, num_workers=2, shuffle=False)
+    got = np.concatenate([b[0] for b in dl])
+    np.testing.assert_allclose(got, X)
+
+
+def test_metrics_accuracy():
+    from paddle_tpu.metric import Accuracy
+    m = Accuracy(topk=(1, 2))
+    pred = paddle.to_tensor(np.array([[0.9, 0.05, 0.05],
+                                      [0.1, 0.8, 0.1],
+                                      [0.3, 0.4, 0.3]], dtype="float32"))
+    label = paddle.to_tensor(np.array([[0], [0], [2]]))
+    correct = m.compute(pred, label)
+    m.update(correct)
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 1 / 3) < 1e-6
+    assert abs(top2 - 2 / 3) < 1e-6
+
+
+def test_model_summary(capsys):
+    model = Model(LeNet())
+    info = model.summary()
+    assert info["total_params"] == 61610

@@ -1,0 +1,184 @@
+"""Layer zoo tests (reference test_layers.py territory)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    out = layer(x)
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(
+        out.numpy(), x.numpy() @ layer.weight.numpy() + layer.bias.numpy(),
+        rtol=1e-5)
+    assert len(layer.parameters()) == 2
+    assert not layer.weight.stop_gradient
+
+
+def test_layer_train_eval_dropout():
+    layer = nn.Dropout(0.5)
+    x = paddle.ones([100])
+    layer.eval()
+    np.testing.assert_allclose(layer(x).numpy(), np.ones(100))
+    layer.train()
+    out = layer(x).numpy()
+    assert (out == 0).any() and (out > 1.0).any()  # upscale_in_train
+
+
+def test_sequential_and_state_dict():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    out = model(x)
+    assert out.shape == (3, 2)
+    sd = model.state_dict()
+    assert len(sd) == 4
+    model2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model2.set_state_dict(sd)
+    np.testing.assert_allclose(model2(x).numpy(), out.numpy(), rtol=1e-6)
+
+
+def test_named_parameters_nested():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(2, 3)
+            self.sub = nn.Sequential(nn.Linear(3, 3))
+
+        def forward(self, x):
+            return self.sub(self.fc1(x))
+
+    net = Net()
+    names = dict(net.named_parameters())
+    assert "fc1.weight" in names and "sub.0.bias" in names
+    assert len(net.parameters()) == 4
+
+
+def test_conv_bn_pool_stack():
+    net = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1),
+        nn.BatchNorm2D(8),
+        nn.ReLU(),
+        nn.MaxPool2D(2),
+    )
+    x = paddle.randn([2, 3, 8, 8])
+    out = net(x)
+    assert out.shape == (2, 8, 4, 4)
+    # BN buffers updated in train mode
+    assert not np.allclose(net[1]._mean.numpy(), 0.0)
+    net.eval()
+    out2 = net(x)
+    assert out2.shape == (2, 8, 4, 4)
+
+
+def test_batchnorm_running_stats_converge():
+    bn = nn.BatchNorm1D(4, momentum=0.0)  # new stats replace old entirely
+    x = paddle.to_tensor(np.random.randn(32, 4).astype("float32") * 2 + 3)
+    bn(x)
+    np.testing.assert_allclose(bn._mean.numpy(), x.numpy().mean(0), rtol=1e-3)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 6, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[1, 2, 0]]))
+    out = emb(ids)
+    assert out.shape == (1, 3, 6)
+    np.testing.assert_allclose(out.numpy()[0, 2], np.zeros(6))
+
+
+def test_layernorm_layer():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([4, 8])
+    out = ln(x).numpy()
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-2)
+
+
+def test_lstm_shapes_and_grad():
+    lstm = nn.LSTM(input_size=5, hidden_size=7, num_layers=2)
+    x = paddle.randn([3, 11, 5])
+    out, (h, c) = lstm(x)
+    assert out.shape == (3, 11, 7)
+    assert h.shape == (2, 3, 7) and c.shape == (2, 3, 7)
+    out.mean().backward()
+    assert lstm.weight_ih_l0.grad is not None
+
+
+def test_bidirectional_gru():
+    gru = nn.GRU(4, 6, direction="bidirect")
+    x = paddle.randn([2, 5, 4])
+    out, h = gru(x)
+    assert out.shape == (2, 5, 12)
+    assert h.shape == (2, 2, 6)
+
+
+def test_lstm_sequence_length_mask():
+    lstm = nn.LSTM(3, 4)
+    x = paddle.randn([2, 6, 3])
+    out, (h, _) = lstm(x, sequence_length=paddle.to_tensor([6, 3]))
+    # final state of batch 1 equals hidden at t=3
+    np.testing.assert_allclose(h.numpy()[0, 1], out.numpy()[1, 2], rtol=1e-5)
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = paddle.randn([2, 5, 16])
+    out = mha(q)
+    assert out.shape == (2, 5, 16)
+    # cross attention
+    kv = paddle.randn([2, 7, 16])
+    out = mha(q, kv, kv)
+    assert out.shape == (2, 5, 16)
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=2,
+                                       dim_feedforward=32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    out = enc(x)
+    assert out.shape == (2, 6, 16)
+    out.mean().backward()
+    grads = [p.grad for p in enc.parameters()]
+    assert all(g is not None for g in grads)
+
+
+def test_full_transformer():
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                           num_decoder_layers=1, dim_feedforward=32,
+                           dropout=0.0)
+    src = paddle.randn([2, 4, 16])
+    tgt = paddle.randn([2, 3, 16])
+    out = model(src, tgt)
+    assert out.shape == (2, 3, 16)
+
+
+def test_loss_layers():
+    ce = nn.CrossEntropyLoss()
+    logits = paddle.randn([4, 10]); logits.stop_gradient = False
+    labels = paddle.to_tensor(np.array([1, 2, 3, 4]))
+    loss = ce(logits, labels)
+    assert loss.shape == ()
+    loss.backward()
+    assert logits.grad is not None
+
+
+def test_forward_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h = layer.register_forward_post_hook(
+        lambda lyr, inp, out: calls.append(out.shape))
+    layer(paddle.randn([3, 2]))
+    assert calls == [(3, 2)]
+    h.remove()
+    layer(paddle.randn([3, 2]))
+    assert len(calls) == 1
+
+
+def test_sublayer_replacement_and_apply():
+    net = nn.Sequential(nn.Linear(2, 2), nn.ReLU())
+    count = [0]
+    net.apply(lambda l: count.__setitem__(0, count[0] + 1))
+    assert count[0] == 3  # self + 2 children

@@ -1,0 +1,164 @@
+"""Optimizer suite tests (reference test_adam_op.py / test_sgd_op.py /
+test_momentum_op.py family + lr scheduler tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def quadratic_problem():
+    w = nn.Parameter(np.array([5.0, -3.0], dtype="float32"))
+    return w
+
+
+def run_steps(opt, w, n=50):
+    for _ in range(n):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (optimizer.SGD, dict(learning_rate=0.1)),
+    (optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (optimizer.Adam, dict(learning_rate=0.2)),
+    (optimizer.AdamW, dict(learning_rate=0.2)),
+    (optimizer.Adamax, dict(learning_rate=0.3)),
+    (optimizer.Adagrad, dict(learning_rate=1.0)),
+    (optimizer.Adadelta, dict(learning_rate=10.0)),
+    (optimizer.RMSProp, dict(learning_rate=0.1)),
+    (optimizer.Lamb, dict(learning_rate=0.1)),
+    (optimizer.Lars, dict(learning_rate=10.0)),
+])
+def test_optimizers_converge(cls, kw):
+    w = quadratic_problem()
+    opt = cls(parameters=[w], **kw)
+    run_steps(opt, w, 60)
+    # Adadelta's unit-correction makes early steps tiny by design; require
+    # solid progress rather than full convergence for it.
+    bound = 3.0 if cls is optimizer.Adadelta else 0.5
+    assert np.abs(w.numpy()).max() < bound, f"{cls.__name__}: {w.numpy()}"
+
+
+def test_adam_matches_reference_formula():
+    w = nn.Parameter(np.array([1.0], dtype="float32"))
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[w],
+                         beta1=0.9, beta2=0.99, epsilon=1e-8)
+    g = np.array([0.5], dtype="float32")
+    loss = (w * paddle.to_tensor(g)).sum()
+    loss.backward()
+    opt.step()
+    m = 0.1 * g
+    v = 0.01 * g * g
+    step = 0.1 * np.sqrt(1 - 0.99) / (1 - 0.9)
+    expected = 1.0 - step * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), expected, rtol=1e-5)
+
+
+def test_weight_decay_coupled():
+    w = nn.Parameter(np.array([1.0], dtype="float32"))
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w], weight_decay=0.1)
+    (w * 0.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 0.1], rtol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    w = nn.Parameter(np.array([1.0], dtype="float32"))
+    opt = optimizer.AdamW(learning_rate=0.1, parameters=[w], weight_decay=0.5)
+    (w * 0.0).sum().backward()
+    opt.step()
+    # grad==0: adam update is 0, only decoupled decay applies
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    w1 = nn.Parameter(np.array([3.0], dtype="float32"))
+    w2 = nn.Parameter(np.array([4.0], dtype="float32"))
+    clip = optimizer.ClipGradByGlobalNorm(1.0)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[w1, w2],
+                        grad_clip=clip)
+    (w1 * 3.0 + w2 * 4.0).backward()  # grads (3, 4), global norm 5
+    opt.step()
+    np.testing.assert_allclose(w1.numpy(), [3.0 - 3.0 / 5.0], rtol=1e-5)
+    np.testing.assert_allclose(w2.numpy(), [4.0 - 4.0 / 5.0], rtol=1e-5)
+
+
+def test_lr_scheduler_step_decay():
+    sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    w = quadratic_problem()
+    opt = optimizer.SGD(learning_rate=sched, parameters=[w])
+    assert opt.get_lr() == 0.1
+    sched.step(); sched.step()
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+def test_lr_schedules_values():
+    lr = optimizer.lr.PiecewiseDecay([3, 6], [0.1, 0.01, 0.001])
+    vals = []
+    for _ in range(8):
+        vals.append(lr())
+        lr.step()
+    assert vals[0] == 0.1 and vals[4] == 0.01 and vals[7] == 0.001
+
+    warm = optimizer.lr.LinearWarmup(0.1, warmup_steps=5, start_lr=0.0,
+                                     end_lr=0.1)
+    v0 = warm()
+    for _ in range(6):
+        warm.step()
+    assert v0 == 0.0 and abs(warm() - 0.1) < 1e-9
+
+    cos = optimizer.lr.CosineAnnealingDecay(0.1, T_max=10)
+    assert abs(cos() - 0.1) < 1e-9
+    for _ in range(10):
+        cos.step()
+    assert cos() < 1e-9
+
+
+def test_optimizer_state_roundtrip(tmp_path):
+    w = quadratic_problem()
+    opt = optimizer.Adam(learning_rate=0.1, parameters=[w])
+    run_steps(opt, w, 3)
+    sd = opt.state_dict()
+    path = str(tmp_path / "opt.pdopt")
+    paddle.save(sd, path)
+
+    w2 = quadratic_problem()
+    opt2 = optimizer.Adam(learning_rate=0.1, parameters=[w2])
+    opt2.set_state_dict(paddle.load(path))
+    assert opt2._step_count == 3
+    key = [k for k in opt2._slots][0]
+    np.testing.assert_allclose(
+        np.asarray(opt2._slots[key]["moment1"]),
+        np.asarray(opt._slots[key]["moment1"]), rtol=1e-6)
+
+
+def test_minimize_api():
+    w = quadratic_problem()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=[w])
+    loss = (w * w).sum()
+    opt.minimize(loss)
+    assert np.abs(w.numpy()).max() < 5.0
+
+
+def test_training_loop_linear_model():
+    paddle.seed(0)
+    net = nn.Linear(3, 1)
+    opt = optimizer.Adam(learning_rate=0.05, parameters=net.parameters())
+    X = paddle.randn([64, 3])
+    true_w = np.array([[1.0], [2.0], [-1.0]], dtype="float32")
+    y = paddle.to_tensor(X.numpy() @ true_w + 0.5)
+    loss_fn = nn.MSELoss()
+    first = None
+    for i in range(150):
+        loss = loss_fn(net(X), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = loss.item()
+    assert loss.item() < first * 0.01
+    np.testing.assert_allclose(net.weight.numpy(), true_w, atol=0.1)

@@ -93,3 +93,71 @@ def test_bf16_matmul_flag():
 
 def test_in_dynamic_mode_importable():
     assert paddle.in_dynamic_mode() in (True, False)
+
+
+def test_gradient_accumulation_matches_big_batch():
+    from paddle_tpu import Model, nn, optimizer
+    paddle.seed(5)
+    X = np.random.randn(8, 4).astype("float32")
+    y = np.random.randn(8, 1).astype("float32")
+
+    def make():
+        paddle.seed(7)
+        net = nn.Linear(4, 1)
+        m = Model(net)
+        m.prepare(optimizer.SGD(learning_rate=0.1,
+                                parameters=net.parameters()),
+                  loss=nn.MSELoss())
+        return m
+
+    m_big = make()
+    m_big.train_batch([X], [y])
+    w_big = m_big.network.weight.numpy()
+
+    m_acc = make()
+    # two half-batches with accumulation; MSE of halves averages to full MSE
+    m_acc.train_batch([X[:4]], [y[:4]], update=False)
+    m_acc.train_batch([X[4:]], [y[4:]], update=True)
+    w_acc = m_acc.network.weight.numpy()
+    np.testing.assert_allclose(w_acc, w_big, rtol=1e-5, atol=1e-6)
+
+
+def test_nll_loss_ignore_index():
+    logp = paddle.to_tensor(np.log(np.full((3, 4), 0.25, "float32")))
+    lab = paddle.to_tensor(np.array([0, -100, 2]))
+    loss = paddle.ops.nll_loss(logp, lab, ignore_index=-100)
+    np.testing.assert_allclose(loss.item(), np.log(4.0), rtol=1e-6)
+
+
+def test_weighted_cross_entropy_normalization():
+    logits = paddle.to_tensor(np.zeros((2, 2), "float32"))
+    labels = paddle.to_tensor(np.array([0, 1]))
+    w = paddle.to_tensor(np.array([1.0, 3.0], "float32"))
+    loss = paddle.ops.cross_entropy(logits, labels, weight=w)
+    # both losses = ln2; weighted mean = (1*ln2 + 3*ln2)/(1+3) = ln2
+    np.testing.assert_allclose(loss.item(), np.log(2.0), rtol=1e-6)
+
+
+def test_instance_norm_independent_attrs():
+    from paddle_tpu import nn
+    layer = nn.InstanceNorm2D(4, bias_attr=False)
+    assert layer.weight is not None and layer.bias is None
+
+
+def test_embedding_negative_padding_idx():
+    from paddle_tpu import nn
+    emb = nn.Embedding(10, 4, padding_idx=-1)
+    out = emb(paddle.to_tensor(np.array([9, 1])))
+    np.testing.assert_allclose(out.numpy()[0], np.zeros(4))
+    assert np.abs(out.numpy()[1]).sum() > 0
+
+
+def test_rnn_wrapper_sequence_mask():
+    from paddle_tpu import nn
+    cell = nn.GRUCell(3, 5)
+    rnn = nn.RNN(cell)
+    x = paddle.randn([2, 6, 3])
+    out, state = rnn(x, initial_states=paddle.zeros([2, 5]),
+                     sequence_length=paddle.to_tensor([6, 3]))
+    assert np.allclose(out.numpy()[1, 3:], 0.0)  # masked outputs
+    np.testing.assert_allclose(state.numpy()[1], out.numpy()[1, 2], rtol=1e-5)
