@@ -119,6 +119,13 @@ def record_op(fn: Callable, args: Sequence[Any], kwargs: dict, name: str = None)
     kw_leaves, kw_tree = jax.tree_util.tree_flatten(kwargs, is_leaf=is_t)
     flat = list(args) + kw_leaves
     n_args = len(args)
+
+    # static-graph mode: symbolic Variables route to program recording
+    # (reference: Program.append_op, fluid/framework.py) instead of executing
+    if _has_static_var(flat):
+        return _record_static(fn, flat, n_args, kw_tree,
+                              name or getattr(fn, "__name__", "op"))
+
     raw = [a._value if is_t(a) else a for a in flat]
 
     def _diffable(a):
@@ -150,6 +157,71 @@ def record_op(fn: Callable, args: Sequence[Any], kwargs: dict, name: str = None)
     node = Node(vjp_fn, [flat[i] for i in diff_idx], out_avals,
                 name or getattr(fn, "__name__", "op"), multi_out)
     return _wrap_outputs(out_val, node=node, stop_gradient=False)
+
+
+def _has_static_var(flat) -> bool:
+    import sys
+    mod = sys.modules.get("paddle_tpu.static.program")
+    if mod is None:
+        return False
+    if not any(isinstance(a, mod.Variable) for a in flat):
+        return False
+    if not mod.in_static_mode():
+        raise RuntimeError(
+            "an op received static-graph Variables while dynamic mode is "
+            "active; run the program through paddle.static.Executor, or "
+            "re-enter paddle.enable_static() before building more graph")
+    return True
+
+
+def _record_static(fn, flat, n_args, kw_tree, name):
+    """Append an op to the current static Program and return symbolic
+    Variables with shapes inferred via jax.eval_shape (the analog of the
+    reference's compile-time InferShape, framework/op_desc.cc)."""
+    from ..static.program import Variable, default_main_program
+    from .tensor import Tensor
+
+    program = None
+    for a in flat:
+        if isinstance(a, Variable) and a.program is not None:
+            program = a.program
+            break
+    program = program or default_main_program()
+
+    def is_dyn(a):
+        return isinstance(a, Tensor) or (hasattr(a, "dtype")
+                                         and hasattr(a, "shape"))
+
+    dyn_idx = [i for i, a in enumerate(flat) if is_dyn(a)]
+
+    def abstract(a):
+        if isinstance(a, Variable):
+            return a.aval
+        if isinstance(a, Tensor):
+            return a._value
+        return a
+
+    def call(*dyn_vals):
+        vals = list(flat)
+        for i, v in zip(dyn_idx, dyn_vals):
+            vals[i] = v
+        kw = jax.tree_util.tree_unflatten(kw_tree, vals[n_args:])
+        return fn(*vals[:n_args], **kw)
+
+    # sandbox the PRNG chain: kernels may draw keys inside eval_shape's
+    # trace, which must not leak tracers into the global generator
+    from . import rng as _rng
+    with _rng.rng_state(jax.random.PRNGKey(0)):
+        out = jax.eval_shape(call, *[abstract(flat[i]) for i in dyn_idx])
+    multi = isinstance(out, (tuple, list))
+    avals = list(out) if multi else [out]
+
+    # literals: eager Tensors become captured constants
+    rec_args = [a._value if (isinstance(a, Tensor)
+                             and not isinstance(a, Variable)) else a
+                for a in flat]
+    out_vars = program.append_op(fn, name, rec_args, n_args, kw_tree, avals)
+    return tuple(out_vars) if multi else out_vars[0]
 
 
 def _wrap_outputs(out_val, node, stop_gradient):

@@ -1,0 +1,190 @@
+"""paddle.jit — dygraph-to-static + model export.
+
+Analog of reference python/paddle/fluid/dygraph/dygraph_to_static/ (23 AST
+transformer modules + program_translator.py) and jit.save/load
+(dygraph/jit.py -> TranslatedLayer).
+
+Design delta (SURVEY.md §7.3 "two frontends, one trace"): no AST rewriting.
+`to_static` compiles the callable by functional extraction + jax.jit — the
+same Python runs as the trace. `save` records the forward into a static
+Program (parameters baked as constants for inference) and pickles it — op
+kernels are module-level jnp functions, so the Program is serializable
+without a proto IR; `load` returns a TranslatedLayer driving the Executor.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import tape as _tape
+from ..core.tensor import Tensor
+from ..hapi.model import InputSpec  # noqa: F401
+from ..nn.layer.layers import Layer
+
+__all__ = ["to_static", "save", "load", "TranslatedLayer", "not_to_static",
+           "ignore_module"]
+
+
+class StaticFunction:
+    """Compiled wrapper over a dygraph callable (reference
+    program_translator.py StaticFunction)."""
+
+    def __init__(self, function, input_spec=None):
+        self._fn = function
+        self._layer = function if isinstance(function, Layer) else None
+        self._input_spec = input_spec
+        self._compiled = {}
+
+    def _key(self, args, kwargs):
+        def one(a):
+            if isinstance(a, Tensor):
+                return (tuple(a.shape), str(a.dtype))
+            try:
+                hash(a)
+                return ("lit", a)
+            except TypeError:
+                return ("lit", repr(a))
+        return (tuple(one(a) for a in args),
+                tuple(sorted((k, one(v)) for k, v in kwargs.items())))
+
+    def __call__(self, *args, **kwargs):
+        import jax
+        import jax.tree_util as jtu
+
+        key = self._key(args, kwargs)
+        if key not in self._compiled:
+            target = self._layer if self._layer is not None else self._fn
+            is_layer = self._layer is not None
+
+            def pure(params, buffers, raw_args):
+                with _tape.no_grad():
+                    if is_layer:
+                        target.load_functional_state(params, buffers)
+                    tin = [Tensor(a, _internal=True) for a in raw_args]
+                    out = target(*tin, **kwargs)
+                    # thread mutated buffers (BN running stats) back out
+                    new_bufs = ({n: b._value for n, b in
+                                 target.named_buffers()} if is_layer else {})
+                raw_out = jtu.tree_map(
+                    lambda t: t._value if isinstance(t, Tensor) else t,
+                    out, is_leaf=lambda t: isinstance(t, Tensor))
+                return raw_out, new_bufs
+
+            self._compiled[key] = jax.jit(pure)
+
+        params, buffers = ({}, {}) if self._layer is None \
+            else self._layer.functional_state()
+        raw = tuple(a._value if isinstance(a, Tensor) else a for a in args)
+        out, new_bufs = self._compiled[key](params, buffers, raw)
+        if self._layer is not None:
+            self._layer.load_functional_state(params, buffers)
+            self._layer.load_functional_state(None, new_bufs)
+        return jtu.tree_map(lambda v: Tensor(v, _internal=True), out)
+
+    # passthroughs for layer-like usage
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None):
+    if function is None:
+        def deco(fn):
+            return StaticFunction(fn, input_spec)
+        return deco
+    return StaticFunction(function, input_spec)
+
+
+def not_to_static(fn):
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class TranslatedLayer(Layer):
+    """Deserialized inference program (reference dygraph/io.py
+    TranslatedLayer)."""
+
+    def __init__(self, program, feed_names):
+        super().__init__()
+        self._program = program
+        self._feed_names = feed_names
+        from ..static.executor import Executor
+        self._exe = Executor()
+
+    def forward(self, *args):
+        feed = {}
+        for name, a in zip(self._feed_names, args):
+            feed[name] = a.numpy() if isinstance(a, Tensor) else np.asarray(a)
+        fetch = self._program._jit_fetch_vars
+        outs = self._exe.run(self._program, feed=feed, fetch_list=fetch)
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Trace `layer` into a Program (params baked as constants) + pickle.
+
+    Produces {path}.pdmodel (program) and {path}.pdiparams (state_dict, for
+    fine-tuning parity with the reference format split).
+    """
+    from .. import static as static_mod
+    from ..framework.io import save as _save
+    from ..static.program import Program, program_guard
+
+    if isinstance(layer, StaticFunction):
+        input_spec = input_spec or layer._input_spec
+        if layer._layer is None:
+            raise TypeError(
+                "jit.save needs a Layer (or to_static-wrapped Layer); "
+                "plain functions have no parameters to export — wrap the "
+                "function in a Layer or save its outputs instead")
+        layer = layer._layer
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec on first save")
+
+    was_training = layer.training
+    layer.eval()
+    program = Program("inference")
+    static_mod.enable_static_()
+    try:
+        with program_guard(program):
+            feeds = []
+            for i, spec in enumerate(input_spec):
+                shape = [1 if (s is None or s == -1) else s
+                         for s in spec.shape]
+                feeds.append(static_mod.data(spec.name or f"x{i}", shape,
+                                             str(np.dtype(spec.dtype)
+                                                 if not isinstance(spec.dtype, str)
+                                                 else spec.dtype)))
+            with _tape.no_grad():
+                out = layer(*feeds)
+    finally:
+        static_mod.disable_static_()
+        if was_training:
+            layer.train()
+
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    program._jit_fetch_vars = list(outs)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    payload = {
+        "program": program,
+        "feed_names": [v.name for v in feeds],
+    }
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(payload, f, protocol=4)
+    _save(layer.state_dict(), path + ".pdiparams")
+
+
+def load(path, **configs):
+    with open(path + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    program = payload["program"]
+    return TranslatedLayer(program, payload["feed_names"])

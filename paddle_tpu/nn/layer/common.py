@@ -93,11 +93,11 @@ class AlphaDropout(Layer):
         b = -a * a_p * self.p
 
         @defop(name="alpha_dropout")
-        def _ad(x, key):
-            mask = jax.random.bernoulli(key, q, x.shape)
+        def _ad(x):
+            mask = jax.random.bernoulli(_rng.next_key(), q, x.shape)
             return (a * jnp.where(mask, x, a_p) + b).astype(x.dtype)
 
-        return _ad(x, _rng.next_key())
+        return _ad(x)
 
 
 class Flatten(Layer):

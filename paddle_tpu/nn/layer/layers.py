@@ -25,6 +25,24 @@ from .. import initializer as I
 __all__ = ["Layer", "Parameter", "ParamAttr"]
 
 
+def _static_mode() -> bool:
+    import sys
+    mod = sys.modules.get("paddle_tpu.static.program")
+    return mod is not None and mod.in_static_mode()
+
+
+def _is_static_param(p) -> bool:
+    import sys
+    mod = sys.modules.get("paddle_tpu.static.program")
+    return mod is not None and isinstance(p, mod.StaticParam)
+
+
+def _is_static_var(v) -> bool:
+    import sys
+    mod = sys.modules.get("paddle_tpu.static.program")
+    return mod is not None and isinstance(v, mod.Variable)
+
+
 class Parameter(Tensor):
     """Trainable tensor owned by a Layer (reference: framework.py Parameter)."""
 
@@ -109,13 +127,30 @@ class Layer:
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         value = init(shape, dtype)
+        if _static_mode():
+            # static graph: parameter = scope-backed symbolic Variable whose
+            # initial value is written to the scope NOW (replacing the
+            # reference's startup-program init ops, initializer.py)
+            from ...static.program import (StaticParam, default_main_program,
+                                           global_scope)
+            pname = attr.name or unique_name.generate("param")
+            sp = StaticParam(shape, dtype, name=pname,
+                             program=default_main_program(),
+                             trainable=attr.trainable,
+                             regularizer=attr.regularizer,
+                             learning_rate=attr.learning_rate,
+                             need_clip=attr.need_clip)
+            global_scope().set(pname, value)
+            default_main_program().add_persistable(sp)
+            return sp
         return Parameter(value, name=attr.name, trainable=attr.trainable,
                          regularizer=attr.regularizer,
                          learning_rate=attr.learning_rate,
                          need_clip=attr.need_clip)
 
     def add_parameter(self, name, parameter):
-        if parameter is not None and not isinstance(parameter, Parameter):
+        if parameter is not None and not isinstance(parameter, Parameter) \
+                and not _is_static_param(parameter):
             raise TypeError("add_parameter expects a Parameter")
         self._parameters[name] = parameter
         return parameter
@@ -127,6 +162,18 @@ class Layer:
     def register_buffer(self, name, tensor, persistable=True):
         if tensor is not None and not isinstance(tensor, Tensor):
             tensor = Tensor(tensor)
+        if tensor is not None and _static_mode() and not _is_static_var(tensor):
+            # scope-backed buffer variable (running stats live in the scope
+            # and round-trip through Program.state_writes each run)
+            from ...static.program import (Variable, default_main_program,
+                                           global_scope)
+            bname = unique_name.generate(f"buffer_{name}")
+            var = Variable(tensor.shape, tensor.dtype, name=bname,
+                           scope_name=bname, program=default_main_program())
+            var.persistable = True
+            global_scope().set(bname, tensor._value)
+            default_main_program().add_persistable(var)
+            tensor = var
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names_set.add(name)
@@ -143,7 +190,7 @@ class Layer:
         params = self.__dict__.get("_parameters")
         layers = self.__dict__.get("_sub_layers")
         buffers = self.__dict__.get("_buffers")
-        if isinstance(value, Parameter):
+        if isinstance(value, Parameter) or _is_static_param(value):
             if params is None:
                 raise RuntimeError("call Layer.__init__ before assigning params")
             params[name] = value

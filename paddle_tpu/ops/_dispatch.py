@@ -31,6 +31,7 @@ def defop(raw_fn=None, *, name=None):
 
         wrapper.raw = f
         wrapper.op_name = opname
+        f.op_name = opname  # lets recorded Programs pickle ops by name
         OP_REGISTRY[opname] = wrapper
         return wrapper
 

@@ -134,6 +134,7 @@ def log_sigmoid(x):
 @defop
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
     from ..core import rng as _rng
+    # key drawn inside the kernel: per-run randomness in recorded programs
     g = jax.random.gumbel(_rng.next_key(), x.shape, x.dtype)
     y = jax.nn.softmax((x + g) / temperature, axis=axis)
     if hard:

@@ -110,7 +110,11 @@ def rms_norm(x, weight=None, epsilon=1e-06):
 
 
 @defop(name="dropout_op")
-def _dropout(x, key, p, mode):
+def _dropout(x, p, mode):
+    # the key is drawn INSIDE the kernel so that recorded static Programs
+    # and jitted steps split it from the per-run chain (core/rng.py) rather
+    # than baking one mask at record time
+    key = _rng.next_key()
     if mode == "upscale_in_train":
         keep = 1.0 - p
         mask = jax.random.bernoulli(key, keep, x.shape)
@@ -121,9 +125,8 @@ def _dropout(x, key, p, mode):
 
 def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None):
     if not training or p == 0.0:
-        return x if hasattr(x, "_value") else x
-    key = _rng.next_key()
-    return _dropout(x, key, p=float(p), mode=mode)
+        return x
+    return _dropout(x, p=float(p), mode=mode)
 
 
 @defop

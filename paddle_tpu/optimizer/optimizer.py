@@ -190,7 +190,20 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        """Dygraph: backward + step (reference optimizer.py minimize)."""
+        """Dygraph: backward + step. Static mode: record backward + update
+        sections into the program (reference optimizer.py minimize /
+        apply_gradients; executed by the static Executor as one compiled
+        step)."""
+        import sys
+        smod = sys.modules.get("paddle_tpu.static.program")
+        if smod is not None and isinstance(loss, smod.Variable):
+            from ..static import append_backward
+            plist = parameters or self._parameter_list
+            pairs = append_backward(loss, parameter_list=plist)
+            program = loss.program or smod.default_main_program()
+            program.optimizer_section = (self, pairs)
+            program._version += 1
+            return [], pairs
         loss.backward()
         self.step()
         return [], []

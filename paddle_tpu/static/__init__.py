@@ -1,0 +1,170 @@
+"""paddle.static — static graph mode.
+
+Analog of reference python/paddle/static/ + python/paddle/fluid
+graph-building (framework.py Program/append_op, executor.py,
+backward.py append_backward, compiler.py CompiledProgram).
+See program.py / executor.py docstrings for the compile-first design.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..hapi.model import InputSpec  # noqa: F401
+from .executor import (BuildStrategy, CompiledProgram, ExecutionStrategy,  # noqa: F401
+                       Executor)
+from .program import (Program, Variable, StaticParam, default_main_program,  # noqa: F401
+                      default_startup_program, disable_static_,
+                      enable_static_, global_scope, in_static_mode,
+                      name_scope, program_guard)
+
+__all__ = ["data", "InputSpec", "Program", "Variable", "Executor",
+           "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+           "program_guard", "name_scope", "default_main_program",
+           "default_startup_program", "global_scope", "append_backward",
+           "gradients", "save", "load", "set_program_state", "nn",
+           "cpu_places", "cuda_places"]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed variable (reference python/paddle/static/input.py data;
+    feed ops become jit arguments). dim values of None/-1 mean
+    'recompile per fed size' — XLA needs static shapes per compilation."""
+    shape = [(-1 if s is None else int(s)) for s in shape]
+    # aval for record-time inference substitutes 1 for dynamic dims; the
+    # executed program re-lowers against the actually-fed shapes.
+    aval_shape = [1 if s == -1 else s for s in shape]
+    var = Variable(aval_shape, dtype, name=name, is_data=True,
+                   program=default_main_program())
+    var.stop_gradient = True
+    default_main_program().add_data_var(var)
+    return var
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Mark the backward section (reference fluid/backward.py:1288).
+
+    Delta: no grad-op chain is woven into the program — the Executor
+    differentiates the lowered forward function with jax.grad at compile
+    time. Returns [(param, grad_var)] like the reference.
+    """
+    program = loss.program or default_main_program()
+    if parameter_list:
+        params = list(parameter_list)
+    else:
+        params = [p for p in program.persistable_vars.values()
+                  if getattr(p, "is_parameter", False)
+                  and getattr(p, "trainable", True)]
+    pairs = []
+    for p in params:
+        g = Variable(p.shape, p.dtype, name=f"{p.name}@GRAD", program=program)
+        pairs.append((p, g))
+    program.backward_section = (loss, pairs)
+    program._version += 1
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference fluid/backward.py:1741 calc_gradient. Currently supports
+    gradients w.r.t. scope-backed parameters (the dominant reference use);
+    grads w.r.t. activations/data are a planned extension."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    scoped = [i for i in inputs if getattr(i, "scope_name", None)]
+    if len(scoped) != len(inputs):
+        bad = [getattr(i, "name", i) for i in inputs
+               if not getattr(i, "scope_name", None)]
+        raise NotImplementedError(
+            f"static gradients() w.r.t. non-parameter variables {bad} is not "
+            "supported yet; use dygraph paddle.grad for activation grads")
+    pairs = append_backward(targets[0], parameter_list=scoped)
+    return [g for _, g in pairs]
+
+
+def set_program_state(program, state_dict):
+    scope = global_scope()
+    import jax.numpy as jnp
+    for name, var in program.persistable_vars.items():
+        if name in state_dict:
+            val = state_dict[name]
+            arr = val.numpy() if hasattr(val, "numpy") else np.asarray(val)
+            scope.set(name, jnp.asarray(arr, var.aval.dtype))
+
+
+def save(program, path, protocol=4):
+    """Persist program persistables from the scope
+    (reference fluid/io.py:620 save_persistables via save ops)."""
+    from ..framework.io import save as _save
+    scope = global_scope()
+    state = {n: np.asarray(scope.get(n))
+             for n in program.persistable_vars if scope.has(n)}
+    _save(state, path + ".pdparams" if not path.endswith(".pdparams") else path)
+
+
+def load(program, path, executor=None, var_list=None):
+    from ..framework.io import load as _load
+    p = path + ".pdparams" if not path.endswith(".pdparams") else path
+    state = _load(p, return_numpy=True)
+    set_program_state(program, state)
+
+
+def cpu_places(device_count=None):
+    from ..device import CPUPlace
+    return [CPUPlace(0)]
+
+
+def cuda_places(device_ids=None):
+    from ..device import TPUPlace
+    return [TPUPlace(0)]
+
+
+class _StaticNN:
+    """paddle.static.nn.* builder shims (reference fluid/layers/nn.py
+    LayerHelper-based builders). Each creates the layer's parameters in the
+    current program and applies it immediately."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+           weight_attr=None, bias_attr=None):
+        from .. import nn, ops
+        in_features = int(np.prod(x.shape[num_flatten_dims:]))
+        layer = nn.Linear(in_features, size, weight_attr=weight_attr,
+                          bias_attr=bias_attr)
+        h = x if x.ndim == 2 else ops.flatten(x, num_flatten_dims)
+        out = layer(h)
+        if activation:
+            out = getattr(nn.functional, activation)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(input, momentum=0.9, epsilon=1e-5, data_layout="NCHW",  # noqa: A002
+                   is_test=False, name=None):
+        from .. import nn
+        c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+        layer = nn.BatchNorm2D(c, momentum=momentum, epsilon=epsilon)
+        layer.training = not is_test
+        return layer(input)
+
+    @staticmethod
+    def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+               dilation=1, groups=1, param_attr=None, bias_attr=None,
+               name=None):
+        from .. import nn
+        layer = nn.Conv2D(input.shape[1], num_filters, filter_size,
+                          stride=stride, padding=padding, dilation=dilation,
+                          groups=groups, weight_attr=param_attr,
+                          bias_attr=bias_attr)
+        return layer(input)
+
+    @staticmethod
+    def embedding(input, size, is_sparse=False, padding_idx=None,  # noqa: A002
+                  param_attr=None, dtype="float32"):
+        from .. import nn
+        layer = nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                             sparse=is_sparse, weight_attr=param_attr)
+        return layer(input)
+
+
+nn = _StaticNN()

@@ -1,0 +1,239 @@
+"""Static-graph Executor.
+
+Analog of reference framework/executor.cc (Executor::Run :179, Prepare :375,
+hot loop :473) + python/paddle/fluid/executor.py (:914 run, :1110 _run_impl
+with program caching). Design delta: `Prepare` = lower the whole Program to
+one pure function; `Run` = call the jitted function once. Feed/fetch-op
+injection, per-op kernel choice, scope var churn and GC all disappear.
+The compiled step carries (feeds, scope, optimizer slots) -> (fetches,
+scope', slots'), with scope/slots donated.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .program import (Program, Variable, _Ref, default_main_program,
+                      default_startup_program, global_scope, in_static_mode)
+
+__all__ = ["Executor", "CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
+
+
+def _resolve(arg, env):
+    if isinstance(arg, _Ref):
+        return env[arg.var_id]
+    return arg
+
+
+class BuildStrategy:
+    """Parity shim for fluid.BuildStrategy (details/build_strategy.cc):
+    XLA owns fusion/memory decisions, so knobs are accepted and recorded."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = None
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100
+
+
+class CompiledProgram:
+    """reference fluid/compiler.py CompiledProgram (:88). with_data_parallel
+    (:164) marks the batch axis for 'dp' mesh sharding instead of cloning
+    the program per device (parallel_executor.cc:606)."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+        self.data_parallel = False
+        self.loss_name = None
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        self.data_parallel = True
+        self.loss_name = loss_name
+        if build_strategy is not None:
+            self.build_strategy = build_strategy
+        return self
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    # -- public API ----------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        feed = feed or {}
+        fetch_list = list(fetch_list or [])
+        data_parallel = False
+        if isinstance(program, CompiledProgram):
+            data_parallel = program.data_parallel
+            program = program.program
+        if program is None:
+            program = default_main_program()
+        if program is default_startup_program() or program.name == "startup":
+            # initializers already ran eagerly at parameter creation
+            # (reference runs startup-program init ops here)
+            return []
+        scope = scope or global_scope()
+
+        feed_vals = {}
+        for name, val in feed.items():
+            var = program.data_vars.get(name)
+            if var is None:
+                raise KeyError(f"feed '{name}' is not a data variable of the "
+                               f"program (have {list(program.data_vars)})")
+            feed_vals[name] = jnp.asarray(np.asarray(val), var.aval.dtype)
+
+        fetch_ids = []
+        for f in fetch_list:
+            if isinstance(f, str):
+                matches = [v for v in program.list_vars() if v.name == f]
+                if not matches:
+                    raise KeyError(f"fetch '{f}' not found in program")
+                fetch_ids.append(matches[0].var_id)
+            else:
+                fetch_ids.append(f.var_id)
+
+        key = (id(program), program._version, tuple(sorted(feed_vals)),
+               tuple(v.shape for _, v in sorted(feed_vals.items())),
+               tuple(fetch_ids), data_parallel)
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._compile(program, sorted(feed_vals), fetch_ids,
+                                  data_parallel)
+            self._cache[key] = entry
+        step, persist_names, opt = entry
+
+        scope_vals = {n: scope.get(n) for n in persist_names}
+        slots, lr, t = {}, jnp.zeros(()), jnp.zeros((), jnp.int32)
+        if opt is not None:
+            pnames = [p.scope_name for p, _ in program.optimizer_section[1]]
+            opt._ensure_slots({n: scope_vals[n] for n in pnames})
+            slots = {n: opt._slots[n] for n in pnames}
+            opt._step_count += 1
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            t = jnp.asarray(opt._step_count, jnp.int32)
+
+        from ..core import rng as _rng
+        fetches, new_scope, new_slots = step(
+            tuple(feed_vals[n] for n in sorted(feed_vals)), scope_vals,
+            slots, lr, t, _rng.next_key())
+
+        for n, v in new_scope.items():
+            scope.set(n, v)
+        if opt is not None:
+            opt._slots.update(new_slots)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    # -- lowering ------------------------------------------------------------
+    def _compile(self, program: Program, feed_names, fetch_ids,
+                 data_parallel):
+        import jax.tree_util as jtu
+        ops = [(op.fn, op.flat, op.n_args, op.kw_tree, op.out_ids)
+               for op in program.ops]
+        persist = list(program.persist_ids.items())
+        persist_names = [n for n, _ in persist]
+        data_ids = {n: v.var_id for n, v in program.data_vars.items()}
+        state_writes = dict(program.state_writes)
+        bwd = program.backward_section
+        opt_sec = program.optimizer_section
+        opt = opt_sec[0] if opt_sec else None
+        meta = None
+        if opt is not None:
+            meta = {p.scope_name: {
+                "lr_ratio": getattr(p, "optimize_attr", {}).get("learning_rate", 1.0),
+                "regularizer": getattr(p, "regularizer", None) or opt._coupled_decay_default(),
+                "need_clip": getattr(p, "need_clip", True)}
+                for p, _ in opt_sec[1]}
+
+        def run_ops(env):
+            for fn, flat, n_args, kw_tree, out_ids in ops:
+                vals = [_resolve(x, env) for x in flat]
+                kw = jtu.tree_unflatten(kw_tree, vals[n_args:])
+                out = fn(*vals[:n_args], **kw)
+                if len(out_ids) == 1 and not isinstance(out, (tuple, list)):
+                    env[out_ids[0]] = out
+                else:
+                    for oid, val in zip(out_ids, out):
+                        env[oid] = val
+            return env
+
+        def step(feed_tuple, scope_vals, slots, lr, t, key):
+            from ..core import rng as _rng
+            with _rng.rng_state(key):
+                env = {}
+                for name, val in zip(sorted(feed_names), feed_tuple):
+                    env[data_ids[name]] = val
+                for name, vid in persist:
+                    env[vid] = scope_vals[name]
+                env = run_ops(dict(env))
+
+                new_slots = slots
+                if bwd is not None:
+                    loss_var, pairs = bwd
+                    grad_names = [p.scope_name for p, _ in pairs]
+
+                    def loss_of(pvals):
+                        env2 = {}
+                        for name, val in zip(sorted(feed_names), feed_tuple):
+                            env2[data_ids[name]] = val
+                        for name, vid in persist:
+                            env2[vid] = pvals.get(name, scope_vals[name])
+                        env2 = run_ops(env2)
+                        return env2[loss_var.var_id]
+
+                    grads = jax.grad(loss_of)(
+                        {n: scope_vals[n] for n in grad_names})
+                    for p, g in pairs:
+                        env[g.var_id] = grads[p.scope_name]
+                    if opt is not None:
+                        pvals = {n: scope_vals[n] for n in grad_names}
+                        new_p, new_slots = opt.apply_gradients_pure(
+                            pvals, grads, slots, lr, t, param_meta=meta)
+                        for n, v in new_p.items():
+                            env[("param", n)] = v
+
+                # every donated scope array must flow back out (unchanged
+                # entries alias through) or the next run reads deleted buffers
+                new_scope = {n: env[vid] for n, vid in persist}
+                for n, vid in state_writes.items():
+                    new_scope[n] = env[vid]
+                if opt is not None and bwd is not None:
+                    for p, _ in opt_sec[1]:
+                        new_scope[p.scope_name] = env[("param", p.scope_name)]
+                fetches = tuple(env[fid] for fid in fetch_ids)
+                return fetches, new_scope, new_slots
+
+        # donating the scope only pays off when the step writes it back
+        donate = (1, 2) if (state_writes or opt is not None) else ()
+        jitted = jax.jit(step, donate_argnums=donate)
+
+        if data_parallel:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..distributed import mesh as mesh_mod
+            mesh = mesh_mod.auto_mesh()
+            if "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
+                repl = NamedSharding(mesh, P())
+                batch = NamedSharding(mesh, P("dp"))
+                jitted = jax.jit(
+                    step,
+                    in_shardings=((batch,) * len(feed_names),
+                                  {n: repl for n in persist_names},
+                                  None, repl, repl, repl),
+                    donate_argnums=donate)
+
+        return jitted, persist_names, opt

@@ -1,0 +1,422 @@
+"""Static graph Program.
+
+Analog of the reference's graph-building layer (reference:
+python/paddle/fluid/framework.py — Program/Block/Operator/Variable around
+:976 and :2900; serialized as framework/framework.proto ProgramDesc).
+
+Design delta (SURVEY.md §7.1 "One IR, compiler-executed"): the Program is a
+flat SSA op list over symbolic Variables. There is no op-by-op interpreter —
+the Executor lowers the whole Program to ONE jitted function (the
+"Executor hot loop" executor.cc:473 becomes a single XLA execution), so
+ChooseKernel/PrepareData/InferShape-at-runtime all disappear into the
+compiler. Parameters and other persistables live in a name→array Scope
+(framework/scope.h analog) threaded through the compiled step and written
+back after each run.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dtype import to_jax_dtype
+
+__all__ = ["Variable", "OpNode", "Program", "Scope", "global_scope",
+           "program_guard", "default_main_program", "default_startup_program",
+           "name_scope"]
+
+
+class Scope:
+    """name -> device array store (reference framework/scope.h)."""
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def get(self, name):
+        return self._vars[name]
+
+    def has(self, name):
+        return name in self._vars
+
+    def find_var(self, name):
+        return _ScopeVarView(self, name) if name in self._vars else None
+
+    def var_names(self):
+        return list(self._vars)
+
+    def drop_kids(self):
+        pass  # parity no-op: no kid scopes needed without per-run var churn
+
+
+class _ScopeVarView:
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        return self._scope.get(self._name)
+
+    def set(self, value, place=None):
+        self._scope.set(self._name, value)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class Variable(Tensor):
+    """Symbolic SSA value (reference framework.py:976 Variable).
+
+    `_value` stays None; shape/dtype come from the recorded aval. A Variable
+    may be scope-backed (persistable parameters/buffers), fed (data), or an
+    intermediate op output.
+    """
+
+    __slots__ = ("aval", "var_id", "is_data", "scope_name", "program")
+
+    _counter = [0]
+    _lock = threading.Lock()
+
+    def __init__(self, shape, dtype, name=None, is_data=False,
+                 scope_name=None, program=None):
+        Tensor.__init__(self, None, stop_gradient=True, _internal=True)
+        self.aval = jax.ShapeDtypeStruct(tuple(shape), to_jax_dtype(dtype))
+        with Variable._lock:
+            Variable._counter[0] += 1
+            self.var_id = Variable._counter[0]
+        self.name = name or f"_var_{self.var_id}"
+        self.is_data = is_data
+        self.scope_name = scope_name
+        self.program = program
+
+    # Tensor surface backed by the aval
+    @property
+    def shape(self):
+        return tuple(int(s) for s in self.aval.shape)
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.aval.shape)) if self.aval.shape else 1
+
+    @property
+    def dtype(self):
+        return self.aval.dtype
+
+    def numpy(self):
+        # persistables are readable from the scope between runs
+        if self.scope_name is not None and global_scope().has(self.scope_name):
+            return np.asarray(global_scope().get(self.scope_name))
+        raise RuntimeError(
+            f"Variable {self.name} has no materialized value; fetch it via "
+            "Executor.run(fetch_list=[...])")
+
+    def set_value(self, value):
+        if self.scope_name is None:
+            raise RuntimeError("only persistable variables can set_value")
+        import jax.numpy as jnp
+        global_scope().set(self.scope_name,
+                           jnp.asarray(np.asarray(value), self.aval.dtype))
+        return self
+
+    def detach(self):
+        # no tape in static mode; symbolic identity is the detachment
+        return self
+
+    def clone(self):
+        from .. import ops
+        return ops.assign(self)
+
+    def __repr__(self):
+        kind = "data" if self.is_data else (
+            "persist" if self.scope_name else "tmp")
+        return (f"Variable(name={self.name}, shape={list(self.shape)}, "
+                f"dtype={self.dtype}, kind={kind})")
+
+    def _rebind(self, new):
+        """In-place write in static mode: later reads see the new SSA value;
+        if scope-backed, the program records a state write-back (how BN
+        running stats persist across runs)."""
+        if isinstance(new, Variable):
+            if self.scope_name is not None and self.program is not None:
+                self.program.state_writes[self.scope_name] = new.var_id
+            # adopt the new SSA identity for subsequent reads
+            self.aval = new.aval
+            self.var_id = new.var_id
+            return self
+        return Tensor._rebind(self, new)
+
+
+class _Ref:
+    """Snapshot of a Variable's SSA id at record time (ids on scope-backed
+    Variables mutate when layers rebind them, e.g. BN running stats)."""
+
+    __slots__ = ("var_id", "name")
+
+    def __init__(self, var: "Variable"):
+        self.var_id = var.var_id
+        self.name = var.name
+
+
+class OpNode:
+    """One recorded op: raw_fn over a flat (args + kwargs-leaves) list;
+    the kwargs pytree is rebuilt at execution time."""
+
+    __slots__ = ("fn", "name", "flat", "n_args", "kw_tree", "out_vars",
+                 "out_ids")
+
+    def __init__(self, fn, name, flat, n_args, kw_tree, out_vars):
+        self.fn = fn
+        self.name = name
+        # snapshot symbolic args as _Refs NOW (ids mutate on rebind)
+        self.flat = [(_Ref(a) if isinstance(a, Variable) else a)
+                     for a in flat]
+        self.n_args = n_args
+        self.kw_tree = kw_tree
+        self.out_vars = out_vars
+        self.out_ids = [o.var_id for o in out_vars]
+
+    # -- pickling: ops serialize by registry name; array literals as numpy --
+    def __getstate__(self):
+        import numpy as _np
+        fn = self.fn
+        fn_ref = ("opreg", fn.op_name) if hasattr(fn, "op_name") else fn
+        flat = [(_np.asarray(a) if hasattr(a, "dtype") and hasattr(a, "shape")
+                 and not isinstance(a, (_Ref, _np.ndarray)) else a)
+                for a in self.flat]
+        return {"fn": fn_ref, "name": self.name, "flat": flat,
+                "n_args": self.n_args, "kw_tree": self.kw_tree,
+                "out_vars": self.out_vars, "out_ids": self.out_ids}
+
+    def __setstate__(self, state):
+        fn = state["fn"]
+        if isinstance(fn, tuple) and fn[0] == "opreg":
+            from ..ops import OP_REGISTRY
+            fn = OP_REGISTRY[fn[1]].raw
+        self.fn = fn
+        self.name = state["name"]
+        self.flat = state["flat"]
+        self.n_args = state["n_args"]
+        self.kw_tree = state["kw_tree"]
+        self.out_vars = state["out_vars"]
+        self.out_ids = state["out_ids"]
+
+
+class Program:
+    """Recorded op list + feed/persistable registry
+    (reference framework.py Program; ProgramDesc proto)."""
+
+    def __init__(self, name="main"):
+        self.name = name
+        self.ops: List[OpNode] = []
+        self.data_vars: Dict[str, Variable] = {}
+        self.persistable_vars: Dict[str, Variable] = {}
+        self.persist_ids: Dict[str, int] = {}
+        self.state_writes: Dict[str, int] = {}  # scope_name -> var_id
+        self.backward_section = None   # (loss_var, [(param_var, grad_var)])
+        self.optimizer_section = None  # (optimizer, [(param_var, grad_var)])
+        self.random_seed = None
+        self._version = 0
+
+    # -- recording ----------------------------------------------------------
+    def append_op(self, fn, name, flat, n_args, kw_tree, out_avals):
+        outs = []
+        for aval in out_avals:
+            v = Variable(aval.shape, aval.dtype, program=self)
+            outs.append(v)
+        self.ops.append(OpNode(fn, name, flat, n_args, kw_tree, outs))
+        self._version += 1
+        return outs
+
+    def add_data_var(self, var: Variable):
+        self.data_vars[var.name] = var
+
+    def add_persistable(self, var: Variable):
+        self.persistable_vars[var.scope_name] = var
+        # reads recorded before any rebind resolve against this seed id
+        self.persist_ids[var.scope_name] = var.var_id
+
+    # -- introspection (parity with Program.to_string / list_vars) ----------
+    def list_vars(self):
+        seen = {}
+        for v in list(self.data_vars.values()) + list(self.persistable_vars.values()):
+            seen[v.var_id] = v
+        for op in self.ops:
+            for v in op.out_vars:
+                seen[v.var_id] = v
+        return list(seen.values())
+
+    def global_block(self):
+        return self
+
+    def all_parameters(self):
+        return [v for v in self.persistable_vars.values()]
+
+    @property
+    def num_blocks(self):
+        return 1
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        lines = [f"Program<{self.name}> ({len(self.ops)} ops)"]
+        for v in self.data_vars.values():
+            lines.append(f"  data  {v.name}: {list(v.shape)} {v.dtype}")
+        for v in self.persistable_vars.values():
+            lines.append(f"  persist {v.scope_name}: {list(v.shape)} {v.dtype}")
+        for op in self.ops:
+            ins = ", ".join(a.name if isinstance(a, _Ref)
+                            else (f"const{list(a.shape)}" if hasattr(a, "shape")
+                                  else repr(a))
+                            for a in op.flat[:op.n_args])
+            outs = ", ".join(o.name for o in op.out_vars)
+            lines.append(f"  {op.name}({ins}) -> {outs}")
+        if self.backward_section:
+            loss, pairs = self.backward_section
+            lines.append(f"  [backward] d{loss.name} -> "
+                         f"{[p.name for p, _ in pairs]}")
+        if self.optimizer_section:
+            opt, pairs = self.optimizer_section
+            lines.append(f"  [optimize] {type(opt).__name__} on "
+                         f"{len(pairs)} params")
+        return "\n".join(lines)
+
+    __str__ = to_string
+
+    def clone(self, for_test=False):
+        p = Program(self.name + ("_test" if for_test else "_clone"))
+        p.ops = ([self._op_for_test(op) for op in self.ops] if for_test
+                 else list(self.ops))
+        p.data_vars = dict(self.data_vars)
+        p.persistable_vars = dict(self.persistable_vars)
+        p.persist_ids = dict(self.persist_ids)
+        # test programs must not advance running statistics
+        p.state_writes = {} if for_test else dict(self.state_writes)
+        if not for_test:
+            p.backward_section = self.backward_section
+            p.optimizer_section = self.optimizer_section
+        return p
+
+    @staticmethod
+    def _op_for_test(op: "OpNode") -> "OpNode":
+        """Rewrite train-mode ops for inference (the reference's
+        clone-for-test op flipping, framework.py Program.clone)."""
+        import jax.tree_util as jtu
+        if op.name == "batch_norm":
+            kw = jtu.tree_unflatten(op.kw_tree, op.flat[op.n_args:])
+            if kw.get("training", False):
+                kw = dict(kw, training=False)
+                leaves, tree = jtu.tree_flatten(kw)
+                new = OpNode.__new__(OpNode)
+                new.fn, new.name = op.fn, op.name
+                new.flat = op.flat[:op.n_args] + leaves
+                new.n_args, new.kw_tree = op.n_args, tree
+                new.out_vars, new.out_ids = op.out_vars, op.out_ids
+                return new
+        if op.name in ("dropout_op", "alpha_dropout"):
+            new = OpNode.__new__(OpNode)
+            new.fn = lambda x, *a, **k: x  # identity at inference
+            new.name = f"{op.name}_identity"
+            new.flat, new.n_args = op.flat, op.n_args
+            new.kw_tree = op.kw_tree
+            new.out_vars, new.out_ids = op.out_vars, op.out_ids
+            return new
+        return op
+
+
+class StaticParam(Variable):
+    """Scope-backed trainable parameter in static mode
+    (reference framework.py Parameter under static graph)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_parameter")
+
+    def __init__(self, shape, dtype, name, program, trainable=True,
+                 regularizer=None, learning_rate=1.0, need_clip=True):
+        super().__init__(shape, dtype, name=name, scope_name=name,
+                         program=program)
+        self.persistable = True
+        self.trainable = trainable
+        self.stop_gradient = not trainable
+        self.optimize_attr = {"learning_rate": learning_rate}
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        self.is_parameter = True
+
+
+# -- default program stack ---------------------------------------------------
+
+class _StaticState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.main = Program("main")
+        self.startup = Program("startup")
+
+
+_state = _StaticState()
+
+
+def in_static_mode() -> bool:
+    return _state.enabled
+
+
+def enable_static_():
+    _state.enabled = True
+
+
+def disable_static_():
+    _state.enabled = False
+
+
+def default_main_program() -> Program:
+    return _state.main
+
+
+def default_startup_program() -> Program:
+    return _state.startup
+
+
+def switch_main_program(program):
+    old = _state.main
+    _state.main = program
+    return old
+
+
+class program_guard:
+    """with program_guard(main, startup): ... (reference framework.py)."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program or Program("startup")
+
+    def __enter__(self):
+        self._old_main = _state.main
+        self._old_startup = _state.startup
+        _state.main = self.main
+        _state.startup = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        _state.main = self._old_main
+        _state.startup = self._old_startup
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

@@ -1,0 +1,58 @@
+"""paddle.jit tests: to_static compilation + save/load export roundtrip
+(reference test_jit_save_load.py territory)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit, nn
+from paddle_tpu.hapi.model import InputSpec
+
+
+def test_to_static_layer_matches_eager():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    x = paddle.randn([3, 4])
+    eager = net(x).numpy()
+    snet = jit.to_static(net)
+    out = snet(x)
+    np.testing.assert_allclose(out.numpy(), eager, rtol=1e-5)
+    # second call hits the jit cache
+    out2 = snet(x)
+    np.testing.assert_allclose(out2.numpy(), eager, rtol=1e-5)
+
+
+def test_to_static_function_decorator():
+    @jit.to_static
+    def f(a, b):
+        return paddle.ops.exp(a) + b
+
+    a = paddle.randn([4])
+    b = paddle.randn([4])
+    np.testing.assert_allclose(f(a, b).numpy(),
+                               np.exp(a.numpy()) + b.numpy(), rtol=1e-5)
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net.eval()
+    path = str(tmp_path / "exported" / "model")
+    jit.save(net, path, input_spec=[InputSpec([None, 4], "float32", "x")])
+
+    loaded = jit.load(path)
+    x = np.random.rand(1, 4).astype("float32")
+    want = net(paddle.to_tensor(x)).numpy()
+    got = loaded(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_jit_save_load_conv_model(tmp_path):
+    from paddle_tpu.vision.models import LeNet
+    net = LeNet()
+    net.eval()
+    path = str(tmp_path / "lenet")
+    jit.save(net, path, input_spec=[InputSpec([1, 1, 28, 28], "float32", "img")])
+    loaded = jit.load(path)
+    x = np.random.rand(1, 1, 28, 28).astype("float32")
+    np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(),
+                               net(paddle.to_tensor(x)).numpy(),
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,157 @@
+"""Static graph tests (reference: static-mode halves of test_layers.py and
+book tests like test_recognize_digits.py static path)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_static_forward_linear():
+    main = static.Program("main")
+    with static.program_guard(main):
+        x = static.data("x", [-1, 4], "float32")
+        net = nn.Linear(4, 3)
+        y = net(x)
+        assert isinstance(y, static.Variable)
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    xv = np.random.rand(5, 4).astype("float32")
+    (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert out.shape == (5, 3)
+    w = static.global_scope().get(net.weight.scope_name)
+    np.testing.assert_allclose(out, xv @ np.asarray(w)
+                               + np.asarray(static.global_scope().get(net.bias.scope_name)),
+                               rtol=1e-5)
+
+
+def test_program_to_string_lists_ops():
+    main = static.Program("m")
+    with static.program_guard(main):
+        x = static.data("x", [2, 2])
+        y = paddle.ops.exp(x) + 1.0
+    s = str(main)
+    assert "exp" in s and "data" in s
+
+
+def test_static_training_converges():
+    main = static.Program("train")
+    with static.program_guard(main):
+        x = static.data("x", [-1, 3], "float32")
+        label = static.data("y", [-1, 1], "float32")
+        net = nn.Linear(3, 1, bias_attr=False)
+        pred = net(x)
+        loss = paddle.ops.mse_loss(pred, label)
+        opt = optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run(static.default_startup_program())
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 3).astype("float32")
+    W = np.array([[1.0], [2.0], [3.0]], dtype="float32")
+    Y = X @ W
+    losses = []
+    for _ in range(200):
+        (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.01, losses[-1]
+    w = np.asarray(static.global_scope().get(net.weight.scope_name))
+    np.testing.assert_allclose(w, W, atol=0.2)
+
+
+def test_append_backward_grads_fetchable():
+    main = static.Program("bwd")
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        net = nn.Linear(2, 1, bias_attr=False)
+        loss = paddle.ops.mean(net(x))
+        pairs = static.append_backward(loss)
+        assert len(pairs) == 1
+    exe = static.Executor()
+    xv = np.ones((2, 2), "float32")
+    (g,) = exe.run(main, feed={"x": xv}, fetch_list=[pairs[0][1]])
+    # d mean(x@w) / dw = mean over batch of x = ones * batch avg
+    np.testing.assert_allclose(g, np.full((2, 1), 1.0), rtol=1e-5)
+
+
+def test_static_batchnorm_state_persists():
+    main = static.Program("bn")
+    with static.program_guard(main):
+        x = static.data("x", [8, 4], "float32")
+        bn = nn.BatchNorm1D(4, momentum=0.5)
+        out = bn(x)
+    exe = static.Executor()
+    xv = np.random.RandomState(0).rand(8, 4).astype("float32") + 5.0
+    exe.run(main, feed={"x": xv}, fetch_list=[out])
+    m1 = np.asarray(static.global_scope().get(bn._mean.scope_name))
+    exe.run(main, feed={"x": xv}, fetch_list=[out])
+    m2 = np.asarray(static.global_scope().get(bn._mean.scope_name))
+    assert not np.allclose(m1, 0.0)
+    assert not np.allclose(m1, m2)  # running stats advanced across runs
+
+
+def test_executor_program_cache():
+    main = static.Program("cache")
+    with static.program_guard(main):
+        x = static.data("x", [4, 4], "float32")
+        y = paddle.ops.exp(x)
+    exe = static.Executor()
+    xv = np.zeros((4, 4), "float32")
+    exe.run(main, feed={"x": xv}, fetch_list=[y])
+    n = len(exe._cache)
+    exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert len(exe._cache) == n  # second run hits the compiled cache
+
+
+def test_static_save_load(tmp_path):
+    main = static.Program("sv")
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        net = nn.Linear(2, 2)
+        y = net(x)
+    path = str(tmp_path / "model")
+    static.save(main, path)
+    old = np.asarray(static.global_scope().get(net.weight.scope_name))
+    static.global_scope().set(net.weight.scope_name, np.zeros((2, 2), "float32"))
+    static.load(main, path)
+    now = np.asarray(static.global_scope().get(net.weight.scope_name))
+    np.testing.assert_allclose(now, old)
+
+
+def test_static_nn_fc():
+    main = static.Program("fc")
+    with static.program_guard(main):
+        x = static.data("x", [3, 5], "float32")
+        y = static.nn.fc(x, size=7, activation="relu")
+    exe = static.Executor()
+    (out,) = exe.run(main, feed={"x": np.random.rand(3, 5).astype("float32")},
+                     fetch_list=[y])
+    assert out.shape == (3, 7)
+    assert (out >= 0).all()
+
+
+def test_data_parallel_compiled_program():
+    # CompiledProgram.with_data_parallel shards the batch over the dp mesh
+    from paddle_tpu.distributed import mesh as mesh_mod
+    import jax
+    mesh_mod.init_mesh({"dp": len(jax.devices())})
+    main = static.Program("dp")
+    with static.program_guard(main):
+        x = static.data("x", [-1, 4], "float32")
+        net = nn.Linear(4, 2)
+        loss = paddle.ops.mean(net(x))
+        optimizer.SGD(learning_rate=0.01).minimize(loss)
+    cp = static.CompiledProgram(main).with_data_parallel(loss_name=loss.name)
+    exe = static.Executor()
+    xv = np.random.rand(16, 4).astype("float32")
+    (l1,) = exe.run(cp, feed={"x": xv}, fetch_list=[loss])
+    (l2,) = exe.run(cp, feed={"x": xv}, fetch_list=[loss])
+    assert l2 < l1

@@ -1,0 +1,119 @@
+"""Regression tests for static-graph review findings."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_static_dropout_mask_differs_per_run(static_mode):
+    main = static.Program("drop")
+    with static.program_guard(main):
+        x = static.data("x", [4, 64], "float32")
+        out = nn.functional.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    xv = np.ones((4, 64), "float32")
+    (a,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    (b,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    assert not np.allclose(a, b), "dropout mask must differ across runs"
+
+
+def test_clone_for_test_freezes_bn_and_drops_dropout(static_mode):
+    main = static.Program("cft")
+    with static.program_guard(main):
+        x = static.data("x", [8, 4], "float32")
+        bn = nn.BatchNorm1D(4)
+        out = nn.functional.dropout(bn(x), p=0.9, training=True)
+    test_prog = main.clone(for_test=True)
+    assert test_prog.state_writes == {}
+    exe = static.Executor()
+    xv = np.random.RandomState(0).rand(8, 4).astype("float32") + 3.0
+    m_before = np.asarray(static.global_scope().get(bn._mean.scope_name))
+    (o1,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+    (o2,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[out])
+    m_after = np.asarray(static.global_scope().get(bn._mean.scope_name))
+    np.testing.assert_allclose(m_before, m_after)  # stats frozen
+    np.testing.assert_allclose(o1, o2)  # dropout removed -> deterministic
+
+
+def test_nontrained_persistable_survives_donation(static_mode):
+    # frozen param is donated but must flow back to the scope untouched
+    main = static.Program("frozen")
+    with static.program_guard(main):
+        x = static.data("x", [4, 4], "float32")
+        frozen = nn.Linear(4, 4)
+        for p in frozen.parameters():
+            p.trainable = False
+            p.stop_gradient = True
+        head = nn.Linear(4, 2)
+        loss = paddle.ops.mean(head(frozen(x)))
+        optimizer.SGD(learning_rate=0.1).minimize(
+            loss, parameters=head.parameters())
+    exe = static.Executor()
+    xv = np.random.rand(4, 4).astype("float32")
+    w0 = np.asarray(static.global_scope().get(frozen.weight.scope_name)).copy()
+    for _ in range(3):
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    w1 = np.asarray(static.global_scope().get(frozen.weight.scope_name))
+    np.testing.assert_allclose(w0, w1)
+
+
+def test_static_vars_in_dynamic_mode_raise(static_mode):
+    main = static.Program("err")
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        net = nn.Linear(2, 2)
+        net(x)
+    paddle.disable_static()
+    try:
+        with pytest.raises(RuntimeError, match="static-graph Variables"):
+            net(paddle.randn([2, 2]))
+    finally:
+        paddle.enable_static()
+
+
+def test_to_static_updates_bn_buffers():
+    from paddle_tpu import jit
+    net = nn.Sequential(nn.BatchNorm1D(4))
+    snet = jit.to_static(net)
+    x = paddle.to_tensor(np.random.rand(16, 4).astype("float32") + 5.0)
+    snet(x)
+    assert not np.allclose(net[0]._mean.numpy(), 0.0)
+
+
+def test_to_static_kwargs_in_cache_key():
+    from paddle_tpu import jit
+
+    @jit.to_static
+    def f(a, scale=1.0):
+        return a * scale
+
+    x = paddle.ones([2])
+    np.testing.assert_allclose(f(x, scale=2.0).numpy(), [2, 2])
+    np.testing.assert_allclose(f(x, scale=3.0).numpy(), [3, 3])
+
+
+def test_jit_save_plain_function_raises():
+    from paddle_tpu import jit
+    from paddle_tpu.hapi.model import InputSpec
+
+    sf = jit.to_static(lambda x: x * 2)
+    with pytest.raises(TypeError, match="Layer"):
+        jit.save(sf, "/tmp/nope", input_spec=[InputSpec([1], "float32")])
+
+
+def test_static_gradients_rejects_data_vars(static_mode):
+    main = static.Program("g")
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        net = nn.Linear(2, 1)
+        loss = paddle.ops.mean(net(x))
+        with pytest.raises(NotImplementedError):
+            static.gradients(loss, [x])
