@@ -1,0 +1,309 @@
+"""Collective communication API.
+
+Analog of reference python/paddle/distributed/collective.py (broadcast :99,
+all_reduce :155, reduce :229, all_gather :311, scatter :384, barrier :455)
+backed by operators/collective/* NCCL kernels (c_allreduce_op.h:123 etc.).
+
+Design delta (SURVEY.md §2.3/§5.8): `ring_id`+comm-stream plumbing is gone.
+Inside an SPMD region (shard_map/pjit over a named mesh axis) these calls
+emit XLA collectives over ICI — the compiler schedules/overlaps them
+(c_sync_calc_stream/c_sync_comm_stream have no analog, by design). Called
+eagerly with world_size==1 they are identity, preserving single-process
+semantics of reference scripts.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..ops._dispatch import defop
+from . import mesh as mesh_mod
+from .env import get_world_size
+
+__all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce", "broadcast",
+           "scatter", "alltoall", "reduce_scatter", "send", "recv", "barrier",
+           "split", "new_group", "wait", "get_group"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """Mesh-axis-backed process group (replaces ring_id registries,
+    platform/collective_helper.h:63)."""
+
+    def __init__(self, axis_name="dp", ranks=None, group_id=0):
+        self.axis = axis_name
+        self.ranks = ranks
+        self.id = group_id
+
+    @property
+    def nranks(self):
+        return mesh_mod.mesh_axis_size(self.axis)
+
+    def get_group_rank(self, rank):
+        return rank
+
+
+_groups = {0: Group("dp", group_id=0)}
+
+
+def new_group(ranks=None, backend=None, axis_name="dp"):
+    gid = max(_groups) + 1
+    g = Group(axis_name, ranks, gid)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _groups.get(gid)
+
+
+def _axis_of(group) -> str:
+    if group is None or group == 0:
+        return "dp"
+    if isinstance(group, Group):
+        return group.axis
+    if isinstance(group, str):
+        return group
+    return "dp"
+
+
+def _in_region(axis):
+    return mesh_mod.in_spmd_region(axis)
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lax.psum,
+    ReduceOp.MAX: lax.pmax,
+    ReduceOp.MIN: lax.pmin,
+    ReduceOp.AVG: lax.pmean,
+}
+
+
+@defop(name="c_allreduce")
+def _allreduce_raw(x, axis, op):
+    if op == ReduceOp.PROD:
+        logs = lax.psum(jnp.log(jnp.abs(x) + 1e-30), axis)
+        sign = lax.psum(jnp.where(x < 0, 1, 0), axis) % 2
+        return jnp.where(sign == 1, -jnp.exp(logs), jnp.exp(logs))
+    return _REDUCERS[op](x, axis)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if not _in_region(axis):
+        if get_world_size() == 1 or mesh_mod.mesh_axis_size(axis) == 1:
+            return tensor  # identity in single-process semantics
+        raise RuntimeError(
+            f"all_reduce over axis '{axis}' called outside an SPMD region; "
+            "wrap the computation in paddle_tpu.distributed.shard (shard_map)"
+            " or use sharded training via fleet/Model.fit")
+    out = _allreduce_raw(tensor, axis=axis, op=op)
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)  # paddle mutates in place
+        return tensor
+    return out
+
+
+@defop(name="c_allgather")
+def _allgather_raw(x, axis):
+    return lax.all_gather(x, axis, axis=0, tiled=False)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if not _in_region(axis):
+        if mesh_mod.mesh_axis_size(axis) == 1:
+            tensor_list.append(tensor)
+            return tensor_list
+        raise RuntimeError("all_gather outside SPMD region")
+    gathered = _allgather_raw(tensor, axis=axis)
+    n = mesh_mod.mesh_axis_size(axis)
+    from .. import ops
+    for i in range(n):
+        tensor_list.append(gathered[i])
+    return tensor_list
+
+
+def all_gather_object(obj_list, obj, group=None):
+    obj_list.append(obj)
+    return obj_list
+
+
+@defop(name="c_reduce")
+def _reduce_raw(x, axis, op, dst):
+    red = _REDUCERS[op](x, axis)
+    idx = lax.axis_index(axis)
+    return jnp.where(idx == dst, red, x)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if not _in_region(axis):
+        if mesh_mod.mesh_axis_size(axis) == 1:
+            return tensor
+        raise RuntimeError("reduce outside SPMD region")
+    out = _reduce_raw(tensor, axis=axis, op=op, dst=dst)
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return out
+
+
+@defop(name="c_broadcast")
+def _broadcast_raw(x, axis, src):
+    n = mesh_mod.mesh_axis_size(axis)
+    mask = (lax.axis_index(axis) == src).astype(x.dtype)
+    return lax.psum(x * mask, axis)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if not _in_region(axis):
+        if mesh_mod.mesh_axis_size(axis) == 1:
+            return tensor
+        raise RuntimeError("broadcast outside SPMD region")
+    out = _broadcast_raw(tensor, axis=axis, src=src)
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return out
+
+
+@defop(name="c_scatter")
+def _scatter_raw(stacked, axis, src):
+    full = _broadcast_raw(stacked, axis, src)
+    idx = lax.axis_index(axis)
+    return lax.dynamic_index_in_dim(full, idx, axis=0, keepdims=False)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    axis = _axis_of(group)
+    if not _in_region(axis):
+        if mesh_mod.mesh_axis_size(axis) == 1:
+            if tensor_list:
+                tensor._rebind(tensor_list[0])
+            return tensor
+        raise RuntimeError("scatter outside SPMD region")
+    from .. import ops
+    stacked = ops.stack(tensor_list, axis=0) if tensor_list else tensor
+    out = _scatter_raw(stacked, axis=axis, src=src)
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return out
+
+
+@defop(name="c_alltoall")
+def _alltoall_raw(x, axis):
+    n = mesh_mod.mesh_axis_size(axis)
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    axis = _axis_of(group)
+    from .. import ops
+    if not _in_region(axis):
+        if mesh_mod.mesh_axis_size(axis) == 1:
+            if out_tensor_list is not None:
+                out_tensor_list.extend(in_tensor_list)
+                return out_tensor_list
+            return in_tensor_list
+        raise RuntimeError("alltoall outside SPMD region")
+    x = ops.stack(in_tensor_list, axis=0) if isinstance(in_tensor_list, list) \
+        else in_tensor_list
+    out = _alltoall_raw(x, axis=axis)
+    if out_tensor_list is not None:
+        n = mesh_mod.mesh_axis_size(axis)
+        for i in range(n):
+            out_tensor_list.append(out[i])
+        return out_tensor_list
+    return out
+
+
+@defop(name="c_reducescatter")
+def _reduce_scatter_raw(x, axis, op):
+    return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    axis = _axis_of(group)
+    from .. import ops
+    if not _in_region(axis):
+        if mesh_mod.mesh_axis_size(axis) == 1:
+            src = tensor_list[0] if tensor_list else tensor
+            if isinstance(tensor, Tensor):
+                tensor._rebind(src)
+            return tensor
+        raise RuntimeError("reduce_scatter outside SPMD region")
+    x = ops.concat(tensor_list, axis=0) if tensor_list else tensor
+    out = _reduce_scatter_raw(x, axis=axis, op=op)
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return out
+
+
+@defop(name="send_v2")
+def _ppermute_raw(x, axis, perm):
+    return lax.ppermute(x, axis, perm)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point send (reference operators/collective/send_v2).
+    In SPMD form, send/recv pairs become a collective_permute; use
+    paddle_tpu.distributed.p2p_permute for the fused form."""
+    raise NotImplementedError(
+        "raw send/recv do not exist in SPMD — use p2p_permute(x, perm) "
+        "(collective_permute) inside shard_map, or the pipeline API")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "raw send/recv do not exist in SPMD — use p2p_permute(x, perm) "
+        "(collective_permute) inside shard_map, or the pipeline API")
+
+
+def p2p_permute(x, perm, axis="pp"):
+    """collective_permute over an axis: perm = [(src, dst), ...]."""
+    if not _in_region(axis):
+        if mesh_mod.mesh_axis_size(axis) == 1:
+            return x
+        raise RuntimeError("p2p_permute outside SPMD region")
+    return _ppermute_raw(x, axis=axis, perm=tuple(perm))
+
+
+def barrier(group=None):
+    """Host-level barrier (reference operators/collective/barrier_op).
+    Single-controller SPMD needs no in-graph barrier; multi-host sync goes
+    through the jax distributed runtime."""
+    if get_world_size() > 1:
+        try:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("paddle_tpu_barrier")
+        except Exception:
+            pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    return tensor  # stream sync is XLA's job
+
+
+def split(x, num_partitions, axis="tp"):
+    """Megatron-style sharded view helper (reference fleet collective split)."""
+    idx = lax.axis_index(axis) if _in_region(axis) else 0
+    from .. import ops
+    parts = ops.split(x, num_partitions, axis=-1)
+    if not _in_region(axis):
+        return parts[0]
+    return parts[int(idx)] if isinstance(idx, int) else \
+        lax.switch(idx, [lambda p=p: p for p in parts])

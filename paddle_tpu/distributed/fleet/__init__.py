@@ -1,0 +1,218 @@
+"""Fleet — the distributed-training user API.
+
+Analog of reference python/paddle/distributed/fleet/ (fleet.init
+fleet_base.py:130, distributed_optimizer :593, DistributedStrategy
+base/distributed_strategy.py:101 over framework/distributed_strategy.proto,
+RoleMaker base/role_maker.py, 16 meta-optimizers under meta_optimizers/).
+
+Design delta (SURVEY.md §3.3): meta-optimizers rewrote the Program op-by-op
+(insert c_allreduce/c_broadcast, split params, prune). Here
+DistributedStrategy maps to *declarative* execution config: a mesh shape +
+sharding rules + step-wrapping transforms (amp/recompute/gradient merge)
+that the compiled step consumes — StrategyCompiler composition collapses
+into picking those settings.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .. import mesh as mesh_mod
+from ..env import ParallelEnv, get_rank, get_world_size
+from .strategy import DistributedStrategy  # noqa: F401
+from .role_maker import (PaddleCloudRoleMaker, Role, UserDefinedRoleMaker)  # noqa: F401
+from . import meta_parallel  # noqa: F401
+
+__all__ = ["init", "DistributedStrategy", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "distributed_optimizer", "worker_index",
+           "worker_num", "is_first_worker", "is_worker", "is_server",
+           "worker_endpoints", "barrier_worker", "init_worker",
+           "stop_worker", "DistributedOptimizer", "get_hybrid_communicate_group"]
+
+_fleet_state = {
+    "initialized": False,
+    "role_maker": None,
+    "strategy": None,
+    "is_collective": True,
+    "hcg": None,
+}
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    """reference fleet_base.py:130. Declares the mesh from the strategy's
+    hybrid degrees (replacing Gloo rendezvous + NCCL ring init)."""
+    strategy = strategy or DistributedStrategy()
+    _fleet_state.update(initialized=True, role_maker=role_maker,
+                        strategy=strategy, is_collective=is_collective)
+    import jax
+    n = len(jax.devices())
+    degrees = strategy.hybrid_configs
+    dp = degrees.get("dp_degree", -1)
+    mp = degrees.get("mp_degree", 1)
+    pp = degrees.get("pp_degree", 1)
+    sp = degrees.get("sep_degree", degrees.get("sp_degree", 1))
+    ep = degrees.get("ep_degree", 1)
+    fixed = mp * pp * sp * ep
+    if dp == -1:
+        dp = max(n // max(fixed, 1), 1)
+    shape = {}
+    if dp > 1 or fixed == 1:
+        shape["dp"] = dp
+    if mp > 1:
+        shape["tp"] = mp
+    if pp > 1:
+        shape["pp"] = pp
+    if sp > 1:
+        shape["sp"] = sp
+    if ep > 1:
+        shape["ep"] = ep
+    if not shape:
+        shape = {"dp": n}
+    total = 1
+    for v in shape.values():
+        total *= v
+    if total != n:
+        shape = {"dp": n}  # fall back to pure DP if degrees don't factor
+    mesh_mod.init_mesh(shape)
+    _fleet_state["hcg"] = HybridCommunicateGroup(shape)
+    return _FleetFacade()
+
+
+class HybridCommunicateGroup:
+    """Topology info (reference fleet/base/topology.py
+    HybridCommunicateGroup)."""
+
+    def __init__(self, shape):
+        self.shape = dict(shape)
+
+    def get_data_parallel_world_size(self):
+        return self.shape.get("dp", 1)
+
+    def get_model_parallel_world_size(self):
+        return self.shape.get("tp", 1)
+
+    def get_pipe_parallel_world_size(self):
+        return self.shape.get("pp", 1)
+
+    def get_sep_parallel_world_size(self):
+        return self.shape.get("sp", 1)
+
+    def get_expert_parallel_world_size(self):
+        return self.shape.get("ep", 1)
+
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+
+def get_hybrid_communicate_group():
+    return _fleet_state["hcg"]
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def is_worker():
+    return True
+
+
+def is_server():
+    return False
+
+
+def worker_endpoints(to_string=False):
+    eps = ParallelEnv().trainer_endpoints
+    return ",".join(eps) if to_string else eps
+
+
+def barrier_worker():
+    from ..collective import barrier
+    barrier()
+
+
+def init_worker():
+    pass
+
+
+def stop_worker():
+    pass
+
+
+def init_server(*args, **kwargs):
+    raise NotImplementedError(
+        "parameter-server mode: the TPU-native embedding/PS stack is the "
+        "planned sharded-embedding subsystem (SURVEY.md §7 hard-parts #5)")
+
+
+run_server = init_server
+
+
+class DistributedOptimizer:
+    """Strategy-composing optimizer wrapper (reference fleet_base.py:593 +
+    StrategyCompiler). Effects are declarative: the strategy's knobs are
+    consumed by the compiled train step (hapi engine / static Executor)."""
+
+    def __init__(self, optimizer, strategy: DistributedStrategy):
+        self.inner_opt = optimizer
+        self.user_defined_strategy = strategy
+        optimizer._dist_strategy = strategy  # engine reads these
+        if strategy.sharding:
+            optimizer._zero_dp = True
+
+    def __getattr__(self, item):
+        return getattr(self.inner_opt, item)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self.inner_opt.minimize(loss, startup_program, parameters,
+                                       no_grad_set)
+
+    def step(self):
+        return self.inner_opt.step()
+
+    def clear_grad(self):
+        return self.inner_opt.clear_grad()
+
+    def state_dict(self):
+        return self.inner_opt.state_dict()
+
+    def set_state_dict(self, state):
+        return self.inner_opt.set_state_dict(state)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    strategy = strategy or _fleet_state.get("strategy") or DistributedStrategy()
+    return DistributedOptimizer(optimizer, strategy)
+
+
+def distributed_model(model):
+    """reference fleet.distributed_model — wraps for data parallelism."""
+    from ..parallel import DataParallel
+    return DataParallel(model)
+
+
+class _FleetFacade:
+    """Object returned by fleet.init supporting the fluent API."""
+
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    distributed_model = staticmethod(distributed_model)
+    worker_index = staticmethod(worker_index)
+    worker_num = staticmethod(worker_num)
+    is_first_worker = staticmethod(is_first_worker)
+    barrier_worker = staticmethod(barrier_worker)
+
+    @property
+    def util(self):
+        from .util import UtilBase
+        return UtilBase()

@@ -1,0 +1,218 @@
+"""Hybrid-parallel building blocks.
+
+Analog of the reference's manual Megatron-style assembly (SURVEY.md §2.2
+"TP": c_allgather/c_reducescatter/send_v2 + split ops composed by hand —
+the reference has no general TP engine). Here TP layers are first-class:
+
+- In the default pjit path, tensor parallelism is pure sharding metadata
+  (distributed/sharding.py rules on plain nn.Linear weights) and GSPMD
+  inserts the collectives.
+- The explicit layers below are for shard_map-style code where the user
+  writes per-device math: column/row-parallel linears with the classic
+  identity/allreduce forward/backward pairs, and a vocab-parallel embedding
+  with masked lookup + psum.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import nn, ops
+from ...ops._dispatch import defop
+from .. import mesh as mesh_mod
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "PipelineLayer", "LayerDesc",
+           "get_rng_state_tracker"]
+
+
+@defop(name="mp_allreduce_identity_bwd")
+def _allreduce_fwd_identity_bwd(x, axis):
+    """f(x)=psum(x); the transpose of psum is identity (g: copy) — exactly
+    the RowParallelLinear output reduction."""
+    return lax.psum(x, axis)
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _identity_fwd_allreduce_bwd_core(x, axis):
+    return x
+
+
+def _ifab_fwd(x, axis):
+    return x, None
+
+
+def _ifab_bwd(axis, _res, g):
+    return (lax.psum(g, axis),)
+
+
+_identity_fwd_allreduce_bwd_core.defvjp(_ifab_fwd, _ifab_bwd)
+
+
+@defop(name="mp_identity_allreduce_bwd")
+def _identity_fwd_allreduce_bwd(x, axis):
+    """f(x)=x with grad psum — the ColumnParallelLinear input copy."""
+    return _identity_fwd_allreduce_bwd_core(x, axis)
+
+
+class ColumnParallelLinear(nn.Layer):
+    """Output-dim sharded linear (weight shard [in, out/tp] per device)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, axis="tp", name=None):
+        super().__init__()
+        self.axis = axis
+        tp = mesh_mod.mesh_axis_size(axis)
+        assert out_features % tp == 0, (out_features, tp)
+        self.out_per_shard = out_features // tp
+        self.gather_output = gather_output
+        self.inner = nn.Linear(in_features, self.out_per_shard,
+                               weight_attr=weight_attr,
+                               bias_attr=None if has_bias else False)
+
+    @property
+    def weight(self):
+        return self.inner.weight
+
+    def forward(self, x):
+        if mesh_mod.in_spmd_region(self.axis):
+            x = _identity_fwd_allreduce_bwd(x, axis=self.axis)
+        out = self.inner(x)
+        if self.gather_output and mesh_mod.in_spmd_region(self.axis):
+            from ..collective import _allgather_raw
+            g = _allgather_raw(out, axis=self.axis)  # [tp, ..., out/tp]
+            parts = ops.unbind(g, 0)
+            out = ops.concat(parts, axis=-1)
+        return out
+
+
+class RowParallelLinear(nn.Layer):
+    """Input-dim sharded linear (weight shard [in/tp, out] per device)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, axis="tp", name=None):
+        super().__init__()
+        self.axis = axis
+        tp = mesh_mod.mesh_axis_size(axis)
+        assert in_features % tp == 0, (in_features, tp)
+        self.in_per_shard = in_features // tp
+        self.input_is_parallel = input_is_parallel
+        self.inner = nn.Linear(self.in_per_shard, out_features,
+                               weight_attr=weight_attr, bias_attr=False)
+        self.bias = self.create_parameter([out_features], is_bias=True) \
+            if has_bias else None
+
+    @property
+    def weight(self):
+        return self.inner.weight
+
+    def forward(self, x):
+        if not self.input_is_parallel and mesh_mod.in_spmd_region(self.axis):
+            idx = lax.axis_index(self.axis)
+            x = lax.dynamic_slice_in_dim(
+                x, idx * self.in_per_shard, self.in_per_shard, axis=-1)
+        out = self.inner(x)
+        if mesh_mod.in_spmd_region(self.axis):
+            out = _allreduce_fwd_identity_bwd(out, axis=self.axis)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(nn.Layer):
+    """Vocab-sharded embedding: masked local lookup + psum."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 axis="tp", name=None):
+        super().__init__()
+        self.axis = axis
+        tp = mesh_mod.mesh_axis_size(axis)
+        assert num_embeddings % tp == 0
+        self.per_shard = num_embeddings // tp
+        self.inner = nn.Embedding(self.per_shard, embedding_dim,
+                                  weight_attr=weight_attr)
+
+    @property
+    def weight(self):
+        return self.inner.weight
+
+    def forward(self, ids):
+        if not mesh_mod.in_spmd_region(self.axis):
+            return self.inner(ids)
+
+        @defop(name="vocab_parallel_lookup")
+        def lookup(weight, ids_raw, axis, per_shard):
+            rank = lax.axis_index(axis)
+            lo = rank * per_shard
+            local = ids_raw - lo
+            valid = (local >= 0) & (local < per_shard)
+            safe = jnp.where(valid, local, 0)
+            emb = jnp.take(weight, safe, axis=0)
+            emb = jnp.where(valid[..., None], emb, 0.0)
+            return lax.psum(emb, axis)
+
+        return lookup(self.inner.weight, ids, axis=self.axis,
+                      per_shard=self.per_shard)
+
+
+class LayerDesc:
+    """Deferred layer construction for pipeline stages
+    (reference fleet/meta_parallel/parallel_layers/pp_layers.py)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class PipelineLayer(nn.Layer):
+    """Stage container: splits a layer list across the 'pp' axis
+    (reference pp_layers.py PipelineLayer). The schedule itself lives in
+    paddle_tpu.distributed.pipeline."""
+
+    def __init__(self, layers, num_stages=None, loss_fn=None,
+                 partition_method="uniform", **kwargs):
+        super().__init__()
+        self.descs = list(layers)
+        self.num_stages = num_stages or mesh_mod.mesh_axis_size("pp")
+        self.loss_fn = loss_fn
+        n = len(self.descs)
+        per = -(-n // self.num_stages)
+        self.stage_bounds = [(i * per, min((i + 1) * per, n))
+                             for i in range(self.num_stages)]
+        built = [d.build() if isinstance(d, LayerDesc) else d
+                 for d in self.descs]
+        self.stages = nn.LayerList([
+            nn.Sequential(*built[lo:hi]) for lo, hi in self.stage_bounds])
+
+    def stage_fn(self, stage_idx):
+        return self.stages[stage_idx]
+
+    def forward(self, x):
+        # reference single-process fallback: run all stages sequentially
+        for s in self.stages:
+            x = s(x)
+        return x
+
+
+class _RNGTracker:
+    def rng_state(self, name="global_seed"):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def add(self, name, seed):
+        pass
+
+
+_tracker = _RNGTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker

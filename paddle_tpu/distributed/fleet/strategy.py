@@ -1,0 +1,69 @@
+"""DistributedStrategy.
+
+Analog of reference framework/distributed_strategy.proto (:115, sub-messages
+:25-113) + python fleet/base/distributed_strategy.py:101. Same knob surface;
+instead of selecting program-rewriting meta-optimizers, the knobs configure
+the compiled step: mesh degrees, sharding rules, amp/recompute/gradient-
+merge wrappers.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # mirroring proto defaults
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0, "use_pure_bf16": True,
+                            "use_dynamic_loss_scaling": True, "level": "O1"}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {"segment_broadcast_MB": 32,
+                                 "sharding_degree": 8, "stage": 2}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1,
+                                 "schedule_mode": "1F1B"}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lamb_configs = {}
+        self.lars = False
+        self.lars_configs = {}
+        self.dgc = False
+        self.dgc_configs = {}
+        self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1}
+        self.adaptive_localsgd = False
+        self.a_sync = False
+        self.a_sync_configs = {}
+        self.elastic = False
+        self.nccl_comm_num = 1  # parity no-op: no NCCL comms to count
+        self.fuse_all_reduce_ops = True  # XLA fuses; accepted for parity
+        self.fuse_grad_size_in_MB = 32
+        self.hybrid_configs = {"dp_degree": -1, "mp_degree": 1,
+                               "pp_degree": 1, "sep_degree": 1,
+                               "ep_degree": 1}
+        self.find_unused_parameters = False
+        self.heter_ccl_mode = False
+
+    # dict-style hybrid_configs setter parity
+    def __setattr__(self, key, value):
+        if key == "hybrid_configs" and isinstance(value, dict) \
+                and hasattr(self, "hybrid_configs"):
+            merged = dict(self.__dict__.get("hybrid_configs", {}))
+            merged.update(value)
+            self.__dict__[key] = merged
+            return
+        self.__dict__[key] = value
+
+    def to_dict(self):
+        return {k: v for k, v in self.__dict__.items()}
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items()
+              if isinstance(v, bool) and v]
+        return f"DistributedStrategy(enabled={on}, hybrid={self.hybrid_configs})"

@@ -1,0 +1,78 @@
+"""Fleet utils (reference fleet/utils/fs.py + base/util_factory.py UtilBase:
+HDFS helpers, all_reduce on host values)."""
+from __future__ import annotations
+
+import os
+import shutil
+
+import numpy as np
+
+__all__ = ["UtilBase", "LocalFS"]
+
+
+class LocalFS:
+    """Local filesystem with the reference's FS interface
+    (reference fleet/utils/fs.py LocalFS; HDFS shells out in the reference,
+    framework/io/fs.cc — cloud FS backends plug in here)."""
+
+    def ls_dir(self, path):
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name))
+             else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.unlink(path)
+
+    def rename(self, src, dst):
+        os.replace(src, dst)
+
+    def upload(self, local, remote):
+        shutil.copy(local, remote)
+
+    def download(self, remote, local):
+        shutil.copy(remote, local)
+
+    def touch(self, path, exist_ok=True):
+        open(path, "a").close()
+
+
+class UtilBase:
+    def __init__(self):
+        self._fs = LocalFS()
+
+    def get_file_system(self):
+        return self._fs
+
+    def all_reduce(self, input, mode="sum"):  # noqa: A002
+        # host-side values; single-controller => identity reduce
+        arr = np.asarray(input)
+        return arr
+
+    def all_gather(self, input):  # noqa: A002
+        return [input]
+
+    def barrier(self):
+        from ..collective import barrier
+        barrier()
+
+    def print_on_rank(self, message, rank_id=0):
+        from ..env import get_rank
+        if get_rank() == rank_id:
+            print(message)

@@ -1,0 +1,75 @@
+"""Process launcher: `python -m paddle_tpu.distributed.launch train.py`.
+
+Analog of reference python/paddle/distributed/launch.py + utils.py
+(get_cluster :297, start_local_trainers :424 setting the PADDLE_* env
+contract and watching children). On TPU, one process per HOST (not per
+chip): jax's single-controller runtime drives all local chips, so
+single-host launches collapse to exec'ing the script with rank 0 env.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "main"]
+
+
+def _build_env(rank, nranks, endpoints):
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nranks),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "PADDLE_RANK_IN_NODE": str(rank),
+        "FLAGS_selected_tpus": str(rank),
+    })
+    return env
+
+
+def launch(script, script_args=(), nproc_per_node=1, host="127.0.0.1",
+           start_port=6170):
+    endpoints = [f"{host}:{start_port + i}" for i in range(nproc_per_node)]
+    procs = []
+    for rank in range(nproc_per_node):
+        cmd = [sys.executable, script, *script_args]
+        p = subprocess.Popen(cmd, env=_build_env(rank, nproc_per_node,
+                                                 endpoints))
+        procs.append(p)
+    # watch loop (reference utils.py watch of child trainers)
+    try:
+        while procs:
+            for p in list(procs):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                procs.remove(p)
+                if ret != 0:
+                    for q in procs:
+                        q.send_signal(signal.SIGTERM)
+                    raise SystemExit(ret)
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        raise
+    return 0
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    ap.add_argument("--nproc_per_node", type=int, default=1)
+    ap.add_argument("--started_port", type=int, default=6170)
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    return launch(args.script, args.script_args, args.nproc_per_node,
+                  start_port=args.started_port)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,11 +41,11 @@ def init_mesh(shape: Dict[str, int] = None, name: str = "default",
     if shape is None:
         shape = {"dp": len(devices)}
     sizes = list(shape.values())
-    if int(np.prod(sizes)) != len(devices):
+    need = int(np.prod(sizes))
+    if need > len(devices):
         raise ValueError(
-            f"mesh shape {shape} needs {int(np.prod(sizes))} devices, "
-            f"have {len(devices)}")
-    arr = np.array(devices).reshape(sizes)
+            f"mesh shape {shape} needs {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(sizes)  # sub-mesh allowed
     mesh = Mesh(arr, tuple(shape.keys()))
     with _lock:
         _meshes[name] = mesh
@@ -87,16 +87,17 @@ def mesh_axis_size(axis: str, name: str = None) -> int:
 
 
 def in_spmd_region(axis: str = None) -> bool:
-    """True when tracing inside shard_map/pjit where `axis` is bound —
+    """True when tracing inside shard_map where `axis` is bound —
     i.e. lax.psum(axis) is legal here."""
     try:
-        core = jax.core
-        env_axes = core.unsafe_get_axis_names() if hasattr(core, "unsafe_get_axis_names") else []
+        from jax._src.core import get_axis_env
+        env = get_axis_env()
+        names = env.axis_names()
     except Exception:
-        env_axes = []
+        return False
     if axis is None:
-        return bool(env_axes)
-    return axis in env_axes
+        return bool(names)
+    return axis in names
 
 
 def named_sharding(spec: PartitionSpec, name: str = None) -> NamedSharding:

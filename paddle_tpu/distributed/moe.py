@@ -1,0 +1,112 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Absent in the reference snapshot (SURVEY.md §2.2 "EP / MoE: build fresh").
+Design: experts are sharded over the 'ep' mesh axis; tokens are routed by a
+top-k softmax gate with capacity, dispatched to expert shards via all-to-all
+on ICI, processed batched on the MXU, and combined back with a second
+all-to-all (the standard Switch/GShard formulation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn, ops
+from ..ops._dispatch import defop
+from . import mesh as mesh_mod
+
+__all__ = ["MoELayer", "switch_route"]
+
+
+def switch_route(gate_logits, num_experts, capacity, k=1):
+    """Top-1 routing with capacity: returns (dispatch, combine).
+
+    dispatch: [tokens, experts, capacity] one-hot
+    combine:  [tokens, experts, capacity] gate-weighted
+    """
+    probs = jax.nn.softmax(gate_logits, axis=-1)            # [T, E]
+    expert = jnp.argmax(probs, axis=-1)                     # [T]
+    gate = jnp.max(probs, axis=-1)                          # [T]
+    onehot = jax.nn.one_hot(expert, num_experts)            # [T, E]
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0         # [T, E]
+    keep = (pos < capacity) & (onehot > 0)
+    pos_cap = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    dispatch = keep[..., None] & (jax.nn.one_hot(pos_cap, capacity) > 0)
+    combine = dispatch.astype(probs.dtype) * gate[:, None, None]
+    return dispatch.astype(probs.dtype), combine
+
+
+class MoELayer(nn.Layer):
+    """Expert-parallel FFN block.
+
+    Outside an SPMD region all experts run locally (dense fallback);
+    inside shard_map over 'ep', each rank holds num_experts/ep experts and
+    tokens move via all-to-all.
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, capacity_factor=1.25,
+                 axis="ep", activation="gelu", k=1):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.axis = axis
+        ep = mesh_mod.mesh_axis_size(axis)
+        assert num_experts % ep == 0, (num_experts, ep)
+        self.experts_per_rank = num_experts // ep
+        self.gate = nn.Linear(d_model, num_experts, bias_attr=False)
+        # expert weights stacked: [E_local, d_model, d_hidden]
+        from ..nn import initializer as I
+        self.w_up = self.create_parameter(
+            [self.experts_per_rank, d_model, d_hidden],
+            default_initializer=I.XavierUniform())
+        self.b_up = self.create_parameter([self.experts_per_rank, d_hidden],
+                                          is_bias=True)
+        self.w_down = self.create_parameter(
+            [self.experts_per_rank, d_hidden, d_model],
+            default_initializer=I.XavierUniform())
+        self.b_down = self.create_parameter([self.experts_per_rank, d_model],
+                                            is_bias=True)
+        self.act = getattr(nn.functional, activation)
+
+    def _expert_ffn(self, x, w_up, b_up, w_down, b_down):
+        # x: [E, cap, d] batched expert matmuls on the MXU
+        h = jnp.einsum("ecd,edh->ech", x, w_up) + b_up[:, None, :]
+        h = jax.nn.gelu(h)
+        return jnp.einsum("ech,ehd->ecd", h, w_down) + b_down[:, None, :]
+
+    def forward(self, x):
+        @defop(name="moe_layer")
+        def run(xv, gate_w, w_up, b_up, w_down, b_down, axis, e_total,
+                e_local, cap_factor):
+            b, s, d = xv.shape
+            tokens = xv.reshape(b * s, d)
+            T = tokens.shape[0]
+            in_region = mesh_mod.in_spmd_region(axis)
+            ep = mesh_mod.mesh_axis_size(axis) if in_region else 1
+            capacity = int(cap_factor * T / e_total) + 1
+            logits = tokens @ gate_w                       # [T, E]
+            dispatch, combine = switch_route(logits, e_total, capacity)
+            # [T,E,C] x [T,d] -> [E, C, d]
+            xin = jnp.einsum("tec,td->ecd", dispatch, tokens)
+            if in_region:
+                # all-to-all: experts dim -> local experts, tokens from all
+                # ranks concatenated on capacity dim
+                xin = lax.all_to_all(xin, axis, split_axis=0, concat_axis=1,
+                                     tiled=True)           # [E/ep, C*ep, d]
+            out = self._expert_ffn(xin, w_up, b_up, w_down, b_down)
+            if in_region:
+                out = lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
+                                     tiled=True)           # [E, C, d]
+            y = jnp.einsum("tec,ecd->td", combine, out)
+            return y.reshape(b, s, d)
+
+        ep = mesh_mod.mesh_axis_size(self.axis) \
+            if mesh_mod.in_spmd_region(self.axis) else 1
+        if ep == 1 and self.experts_per_rank != self.num_experts:
+            raise RuntimeError("MoELayer built for ep>1 used outside SPMD")
+        return run(x, self.gate.weight, self.w_up, self.b_up, self.w_down,
+                   self.b_down, axis=self.axis, e_total=self.num_experts,
+                   e_local=self.experts_per_rank,
+                   cap_factor=self.capacity_factor)

@@ -1,0 +1,82 @@
+"""Pipeline parallelism.
+
+Analog of reference PipelineOptimizer + PipelineTrainer/SectionWorker
+(python/paddle/fluid/optimizer.py:3695 program splitter;
+framework/section_worker.cc:61-117 — per-microbatch forward for all, then
+backward for all, optimizer once: GPipe F-then-B).
+
+TPU design delta (SURVEY.md §2.2 "PP"): no per-stage programs or section
+threads. All pp ranks run ONE SPMD program under shard_map: stage 0 injects
+a fresh microbatch each tick, activations hop to the next stage via
+collective-permute, and the last stage emits finished microbatches. The
+backward schedule is jax AD of this loop — F-then-B falls out of
+differentiating it; XLA overlaps each tick's ppermute with the next tick's
+stage matmuls on ICI.
+
+Stages must be homogeneous (hidden -> hidden, same shape/dtype): apply the
+embedding before entering the pipeline and the head after, as in standard
+SPMD pipelining. PipelineLayer (fleet.meta_parallel) produces the per-rank
+stage function.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import mesh as mesh_mod
+
+__all__ = ["micro_batch", "gpipe", "pipeline_loss"]
+
+
+def micro_batch(x, num_micro):
+    """[B, ...] -> [num_micro, B/num_micro, ...]"""
+    b = x.shape[0]
+    assert b % num_micro == 0, (b, num_micro)
+    return x.reshape((num_micro, b // num_micro) + x.shape[1:])
+
+
+def gpipe(stage_fn: Callable, x_micro, axis: str = "pp"):
+    """GPipe schedule inside shard_map.
+
+    stage_fn(h) -> h: THIS rank's stage (closed over its local params),
+    hidden-shaped in and out. x_micro: [M, mb, ...] hidden-shaped
+    microbatches (only stage 0 actually consumes them).
+    Returns [M, mb, ...]; entries are the completed pipeline outputs on the
+    LAST stage (garbage elsewhere — mask by rank).
+    """
+    n = mesh_mod.mesh_axis_size(axis)
+    rank = lax.axis_index(axis)
+    M = x_micro.shape[0]
+    ticks = M + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    is_first = (rank == 0)
+
+    carry = jnp.zeros_like(x_micro[0])
+    outs = jnp.zeros_like(x_micro)
+    for t in range(ticks):
+        inject = x_micro[min(t, M - 1)]
+        h = jnp.where(is_first, inject, carry)
+        h_out = stage_fn(h)
+        mb_done = t - (n - 1)
+        if 0 <= mb_done < M:
+            outs = outs.at[mb_done].set(h_out)
+        carry = lax.ppermute(h_out, axis, perm)
+    return outs
+
+
+def pipeline_loss(stage_fn, loss_fn, x_micro, labels_micro, axis="pp"):
+    """Mean microbatch loss of the pipelined stack; identical scalar on all
+    ranks (each rank's grads flow only to its own stage params through the
+    permutes — the SectionWorker F-then-B equivalent under AD)."""
+    n = mesh_mod.mesh_axis_size(axis)
+    rank = lax.axis_index(axis)
+    outs = gpipe(stage_fn, x_micro, axis)
+    M = x_micro.shape[0]
+    total = jnp.zeros((), jnp.float32)
+    on_last = (rank == n - 1).astype(jnp.float32)
+    for m in range(M):
+        total = total + loss_fn(outs[m], labels_micro[m]).astype(jnp.float32) \
+            * on_last
+    return lax.psum(total, axis) / M

@@ -1,0 +1,159 @@
+"""Ring attention — sequence/context parallelism for long sequences.
+
+The reference has NO long-context subsystem (SURVEY.md §5.7: exhaustive
+grep confirms no ring attention / sequence parallel / Ulysses; its only
+tools are recompute + pipeline micro-batching). This module is the designed-
+from-scratch capability: Q/K/V are sharded along the sequence axis over the
+'sp' mesh dimension; K/V blocks rotate around the ring via collective-
+permute while each device accumulates its queries' attention with an
+online-softmax (flash-attention-style log-sum-exp carry), so peak memory is
+O(seq_local^2) and communication rides the ICI ring.
+
+Also provides `ulysses_attention`: the all-to-all alternative (seq-shard ->
+head-shard re-partition), preferable when head_count >= sp_degree.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops._dispatch import defop
+from . import mesh as mesh_mod
+
+__all__ = ["ring_attention", "ulysses_attention", "sequence_parallel_attention"]
+
+
+def _ring_attention_raw(q, k, v, axis, causal, scale):
+    """q,k,v: [batch, heads, seq_local, dim] per device; seq sharded on
+    `axis`. Online-softmax accumulation over ring steps."""
+    n = mesh_mod.mesh_axis_size(axis)
+    my = lax.axis_index(axis)
+    s_local = q.shape[2]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    q_scaled = q * scale
+
+    # global query positions for causal masking
+    q_pos = my * s_local + jnp.arange(s_local)  # [s_local]
+
+    def step(i, carry):
+        k_cur, v_cur, o, m, l = carry
+        # kv block i came from rank (my - i) mod n
+        src = (my - i) % n
+        k_pos = src * s_local + jnp.arange(s_local)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q_scaled, k_cur,
+                            preferred_element_type=jnp.float32)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        blk_max = jnp.max(logits, axis=-1)                      # [b,h,q]
+        m_new = jnp.maximum(m, blk_max)
+        # guard fully-masked blocks (all -inf)
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(logits - m_safe[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        correction = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        o_new = (o * correction[..., None]
+                 + jnp.einsum("bhqk,bhkd->bhqd", p,
+                              v_cur.astype(p.dtype)))
+        # rotate kv to the next rank (ring over ICI)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = lax.ppermute(k_cur, axis, perm)
+        v_next = lax.ppermute(v_cur, axis, perm)
+        return (k_next, v_next, o_new, m_new, l_new)
+
+    b, h, s, d = q.shape
+    o0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m0 = jnp.full((b, h, s), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    carry = (k, v, o0, m0, l0)
+    # unrolled python loop: n is small (mesh dim); lets XLA overlap the
+    # ppermute of step i+1 with the matmuls of step i
+    for i in range(n):
+        carry = step(i, carry)
+    _, _, o, m, l = carry
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    return out.astype(q.dtype)
+
+
+@defop(name="ring_attention")
+def _ring_attention_op(q, k, v, axis, causal, scale):
+    return _ring_attention_raw(q, k, v, axis, causal, scale)
+
+
+def ring_attention(q, k, v, axis="sp", causal=False, scale=None):
+    """Per-device attention over ring-rotated KV. Call inside a shard_map
+    region with the sequence axis sharded on `axis`; outside an SPMD region
+    falls back to exact single-device attention."""
+    if not mesh_mod.in_spmd_region(axis):
+        from ..nn.functional import scaled_dot_product_attention
+        return scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                            scale=scale, training=False)
+    return _ring_attention_op(q, k, v, axis=axis, causal=causal, scale=scale)
+
+
+def _ulysses_raw(q, k, v, axis, causal, scale):
+    """All-to-all: [b, h, s/n, d] -> [b, h/n, s, d], full attention locally,
+    then back (DeepSpeed-Ulysses style)."""
+    n = mesh_mod.mesh_axis_size(axis)
+    h = q.shape[1]
+    assert h % n == 0, f"heads {h} not divisible by sp degree {n}"
+
+    def seq_to_head(x):
+        # split heads across ranks, gather sequence
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def head_to_seq(x):
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    sc = scale if scale is not None else q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh * sc, kh,
+                        preferred_element_type=jnp.float32)
+    if causal:
+        s = logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    p = jax.nn.softmax(logits, axis=-1).astype(vh.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vh)
+    return head_to_seq(out)
+
+
+@defop(name="ulysses_attention")
+def _ulysses_op(q, k, v, axis, causal, scale):
+    return _ulysses_raw(q, k, v, axis, causal, scale)
+
+
+def ulysses_attention(q, k, v, axis="sp", causal=False, scale=None):
+    if not mesh_mod.in_spmd_region(axis):
+        from ..nn.functional import scaled_dot_product_attention
+        return scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                            scale=scale, training=False)
+    return _ulysses_op(q, k, v, axis=axis, causal=causal, scale=scale)
+
+
+def sequence_parallel_attention(q, k, v, mesh=None, axis="sp", causal=False,
+                                scale=None, mode="ring"):
+    """Convenience wrapper: shard full [b,h,s,d] arrays on the sequence axis
+    and run ring/ulysses attention under shard_map."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    from ..core.tensor import Tensor
+
+    mesh = mesh or mesh_mod.auto_mesh()
+    raw = [x._value if isinstance(x, Tensor) else x for x in (q, k, v)]
+    spec = P(None, None, axis, None)
+    fn = _ring_attention_raw if mode == "ring" else _ulysses_raw
+
+    def local(ql, kl, vl):
+        return fn(ql, kl, vl, axis, causal, scale)
+
+    out = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec)(*raw)
+    if isinstance(q, Tensor):
+        return Tensor(out, stop_gradient=True, _internal=True)
+    return out

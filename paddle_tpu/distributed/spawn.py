@@ -1,0 +1,31 @@
+"""paddle.distributed.spawn (reference python/paddle/distributed/spawn.py):
+fork worker processes running `func(rank, *args)` with the PADDLE_* env."""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+
+__all__ = ["spawn"]
+
+
+def _worker(rank, nprocs, func, args):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    func(*args)
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker, args=(rank, nprocs, func, args),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawned workers failed with codes {bad}")
+    return procs

@@ -87,6 +87,29 @@ class _CompiledEngine:
         raw_outs = [o._value for o in outs_list]
         return loss, raw_outs, new_bufs
 
+    def _sharding_plan(self):
+        """When a mesh is active, build GSPMD shardings: batch on dp(+sp),
+        params by TP/ZeRO name rules, slots following their params
+        (the declarative replacement for fleet meta-optimizer program
+        surgery — SURVEY.md §2.2)."""
+        from ..distributed import mesh as mesh_mod
+        mesh = mesh_mod.get_mesh()
+        if mesh is None or int(np.prod(list(mesh.shape.values()))) == 1:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..distributed.sharding import build_param_shardings
+        net = self.model.network
+        opt = self.model._optimizer
+        zero = bool(getattr(opt, "_zero_dp", False)) \
+            or bool(getattr(net, "_zero_dp", False))
+        params, buffers = net.functional_state()
+        param_sh = build_param_shardings(params, mesh, zero_dp=zero)
+        repl = NamedSharding(mesh, P())
+        batch = NamedSharding(mesh, P("dp") if "dp" in mesh.axis_names
+                              else P())
+        return {"mesh": mesh, "param": param_sh, "repl": repl,
+                "batch": batch}
+
     def _build_train_fn(self):
         model = self.model
         opt = model._optimizer
@@ -117,7 +140,21 @@ class _CompiledEngine:
                 new_params.update(new_train)
             return lval, outs, new_bufs, new_params, new_slots
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        plan = self._sharding_plan()
+        if plan is None:
+            return jax.jit(step, donate_argnums=(0, 1, 2))
+        # distributed: partition the whole step via GSPMD
+        opt_state = self.model._optimizer._slots
+        slot_sh = {k: {s: plan["param"][k] for s in opt_state.get(k, {})}
+                   for k in opt_state}
+        buffers_sh = {n: plan["repl"] for n, _ in
+                      self.model.network.named_buffers()}
+        return jax.jit(
+            step,
+            in_shardings=(plan["param"], buffers_sh, slot_sh, plan["repl"],
+                          plan["repl"], plan["repl"], plan["batch"],
+                          plan["batch"]),
+            donate_argnums=(0, 1, 2))
 
     def _build_grad_fn(self):
         """Forward+backward only — used for gradient accumulation
