@@ -1,0 +1,102 @@
+"""Ablation profiling of the bench step on the real chip."""
+import os, sys, time
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import paddle_tpu as paddle
+from paddle_tpu import optimizer as opt_mod
+from paddle_tpu.core import rng as _rng
+from paddle_tpu.core import tape as _tape
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.text.models.bert import Bert, BertConfig, BertPretrainingCriterion
+
+BATCH, SEQ, STEPS, WARMUP = 32, 128, 10, 3
+
+cfg = BertConfig.bert_base()
+paddle.seed(0)
+net = Bert(cfg)
+net.train()
+criterion = BertPretrainingCriterion(cfg.vocab_size)
+optimizer = opt_mod.AdamW(learning_rate=1e-4, parameters=net.parameters())
+params, buffers = net.functional_state()
+params = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
+          for k, v in params.items()}
+named = dict(net.named_parameters())
+optimizer._ensure_slots(params)
+slots0 = dict(optimizer._slots)
+meta = optimizer._param_meta(named)
+
+rng_np = np.random.RandomState(0)
+ids64 = jnp.asarray(rng_np.randint(4, cfg.vocab_size, (BATCH, SEQ)), jnp.int64)
+ids32 = ids64.astype(jnp.int32)
+mask = rng_np.rand(BATCH, SEQ) < 0.15
+labels64 = jnp.asarray(np.where(mask, rng_np.randint(4, cfg.vocab_size, (BATCH, SEQ)), -100), jnp.int64)
+labels32 = labels64.astype(jnp.int32)
+lr = jnp.asarray(1e-4, jnp.float32)
+key = jax.random.PRNGKey(0)
+t_arr = jnp.asarray(1, jnp.int32)
+
+
+def timeit(name, fn, *args):
+    for _ in range(WARMUP):
+        out = fn(*args)
+    jax.tree_util.tree_map(lambda x: np.asarray(x) if hasattr(x, 'shape') and x.size == 1 else None,
+                           out[0] if isinstance(out, tuple) else out)
+    # sync via readback of first leaf
+    leaves = jax.tree_util.tree_leaves(out)
+    _ = np.asarray(leaves[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        out = fn(*args)
+    leaves = jax.tree_util.tree_leaves(out)
+    _ = np.asarray(leaves[0]).ravel()[:1]
+    dt = (time.perf_counter() - t0) / STEPS
+    print(f"{name:40s} {dt*1000:8.2f} ms")
+    return dt
+
+
+def make_step(train=True, with_opt=True, eval_mode=False):
+    def loss_of(p, ids, labels):
+        net.load_functional_state(p, buffers)
+        logits = net(Tensor(ids, _internal=True))
+        loss = criterion(logits, Tensor(labels, _internal=True))
+        return loss._value.astype(jnp.float32)
+
+    if not train:
+        def fwd(params, slots, ids, labels):
+            with _rng.rng_state(key), _tape.no_grad():
+                return loss_of(params, ids, labels)
+        return jax.jit(fwd)
+
+    def step(params, slots, ids, labels):
+        with _rng.rng_state(key), _tape.no_grad():
+            loss, grads = jax.value_and_grad(loss_of)(params, ids, labels)
+            if with_opt:
+                params, slots = optimizer.apply_gradients_pure(
+                    params, grads, slots, lr, t_arr, param_meta=meta)
+            else:
+                params = jax.tree_util.tree_map(lambda p, g: p - 0.0 * g.astype(p.dtype), params, grads)
+        return loss, params, slots
+    return jax.jit(step)
+
+
+full = make_step()
+timeit("full step (baseline, int64 ids)", full, params, slots0, ids64, labels64)
+timeit("full step (int32 ids)", full, params, slots0, ids32, labels32)
+
+fwd_bwd = make_step(with_opt=False)
+timeit("fwd+bwd only (int64)", fwd_bwd, params, slots0, ids64, labels64)
+
+fwd = make_step(train=False)
+timeit("fwd only (int64)", fwd, params, slots0, ids64, labels64)
+
+net.eval()  # disables dropout
+fwd_eval = make_step(train=False)
+timeit("fwd only, eval mode (no dropout)", fwd_eval, params, slots0, ids64, labels64)
+full_eval = make_step()
+timeit("full step, no dropout (int64)", full_eval, params, slots0, ids64, labels64)
+timeit("full step, no dropout (int32)", full_eval, params, slots0, ids32, labels32)
+net.train()

@@ -1,0 +1,27 @@
+import time, numpy as np, jax, jax.numpy as jnp
+
+def timeit(name, fn, *args):
+    for _ in range(3):
+        out = fn(*args)
+    _ = np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(20):
+        out = fn(*args)
+    _ = np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+    print(f"{name:45s} {(time.perf_counter()-t0)/20*1000:8.3f} ms")
+
+x = jnp.ones((32, 128, 768), jnp.bfloat16)
+# simulate one step's worth of dropout: 12 layers x (attn probs + 2 hidden)
+shapes = [(32, 12, 128, 128), (32, 128, 768), (32, 128, 768)] * 12
+
+def run(key):
+    outs = []
+    for s in shapes:
+        key, sub = jax.random.split(key)
+        m = jax.random.bernoulli(sub, 0.9, s)
+        outs.append(m.sum())
+    return sum(outs)
+
+for impl in ["threefry2x32", "rbg", "unsafe_rbg"]:
+    k = jax.random.key(0, impl=impl)
+    timeit(f"36 dropout masks impl={impl}", jax.jit(run), k)

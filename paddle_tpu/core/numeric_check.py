@@ -1,0 +1,68 @@
+"""Numeric debugging: the FLAGS_check_nan_inf sweep.
+
+TPU-native analog of the reference's per-op nan/inf validation
+(reference: paddle/fluid/framework/details/nan_inf_utils_detail.cu:94 CUDA
+sweep + nan_inf_utils_detail.cc:177 CPU path, enabled by
+platform/flags.cc:44 FLAGS_check_nan_inf). Two tiers:
+
+- eager ops: `check_op_outputs` runs right after each kernel in
+  core/tape.record_op — concrete values only (tracers are covered by the
+  post-step sweep), raising with the op name like the reference's
+  EnforceNotMet does.
+- compiled steps: `sweep` host-checks a pytree of step outputs (loss,
+  fetches, updated scope/params) after the jitted call returns, naming every
+  offending entry.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from . import flags as _flags
+
+
+def enabled() -> bool:
+    return bool(_flags.flag("FLAGS_check_nan_inf"))
+
+
+def _is_concrete_float(v):
+    if isinstance(v, jax.core.Tracer):
+        return False
+    return hasattr(v, "dtype") and np.issubdtype(np.dtype(v.dtype),
+                                                 np.floating)
+
+
+def check_op_outputs(op_name: str, out_val):
+    """Raise if any concrete floating output of an eager op has nan/inf."""
+    outs = out_val if isinstance(out_val, (tuple, list)) else [out_val]
+    for i, v in enumerate(outs):
+        if not _is_concrete_float(v):
+            continue
+        arr = np.asarray(v)
+        if not np.isfinite(arr).all():
+            n_nan = int(np.isnan(arr).sum())
+            n_inf = int(np.isinf(arr).sum())
+            raise RuntimeError(
+                f"[FLAGS_check_nan_inf] op '{op_name}' output {i} contains "
+                f"{n_nan} nan / {n_inf} inf values "
+                f"(shape={tuple(arr.shape)}, dtype={arr.dtype})")
+
+
+def sweep(tree, context: str):
+    """Host-check every floating leaf of `tree`; raise naming the bad ones."""
+    bad = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, v in flat:
+        if not _is_concrete_float(v):
+            continue
+        arr = np.asarray(v)
+        if not np.isfinite(arr).all():
+            name = jax.tree_util.keystr(path)
+            bad.append(f"{name}: {int(np.isnan(arr).sum())} nan / "
+                       f"{int(np.isinf(arr).sum())} inf "
+                       f"(shape={tuple(arr.shape)})")
+    if bad:
+        raise RuntimeError(
+            f"[FLAGS_check_nan_inf] non-finite values after {context}:\n  " +
+            "\n  ".join(bad))

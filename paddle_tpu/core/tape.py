@@ -29,6 +29,8 @@ from typing import Any, Callable, List, Optional, Sequence
 import jax
 import numpy as np
 
+from . import flags as _flags
+
 __all__ = [
     "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
     "Node", "record_op", "backward", "grad",
@@ -142,6 +144,9 @@ def record_op(fn: Callable, args: Sequence[Any], kwargs: dict, name: str = None)
 
     if not diff_idx:
         out_val = _call(raw)
+        if _flags.flag("FLAGS_check_nan_inf"):
+            from .numeric_check import check_op_outputs
+            check_op_outputs(name or getattr(fn, "__name__", "op"), out_val)
         return _wrap_outputs(out_val, node=None, stop_gradient=True)
 
     def closed(*diff_vals):
@@ -151,6 +156,9 @@ def record_op(fn: Callable, args: Sequence[Any], kwargs: dict, name: str = None)
         return _call(full)
 
     out_val, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+    if _flags.flag("FLAGS_check_nan_inf"):
+        from .numeric_check import check_op_outputs
+        check_op_outputs(name or getattr(fn, "__name__", "op"), out_val)
     multi_out = isinstance(out_val, (tuple, list))
     outs = list(out_val) if multi_out else [out_val]
     out_avals = [(tuple(o.shape), o.dtype) for o in outs]
@@ -395,14 +403,23 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
     results = []
     for t in inputs:
-        gval = capture[id(t)]
-        if gval is None:
+        seed_g = None  # identity cotangent when the input IS an output
+        for o, g in seeds:
+            if o is t:
+                seed_g = g if seed_g is None else seed_g + g
+        if t._node is None:
+            # leaf: the engine merges seed + consumer paths into leaf_grads
             gval = leaf_grads.get(id(t))
-        if gval is None:
-            # output may BE the input
-            for o, g in seeds:
-                if o is t:
-                    gval = g
+            if gval is None:
+                gval = capture[id(t)]
+            if gval is None:
+                gval = seed_g
+        else:
+            # non-leaf: capture holds consumer-path grads only; the seed
+            # contribution must be SUMMED in, not used as a mere fallback
+            gval = capture[id(t)]
+            if seed_g is not None:
+                gval = seed_g if gval is None else gval + seed_g
         if gval is None:
             if not allow_unused:
                 raise RuntimeError("one of the inputs was not used in the graph "

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core.tensor import Tensor
@@ -37,19 +38,36 @@ class ReduceOp:
 
 class Group:
     """Mesh-axis-backed process group (replaces ring_id registries,
-    platform/collective_helper.h:63)."""
+    platform/collective_helper.h:63). A rank subset becomes XLA
+    `axis_index_groups` — members collect among themselves, non-members
+    pass through as singleton groups."""
 
     def __init__(self, axis_name="dp", ranks=None, group_id=0):
         self.axis = axis_name
-        self.ranks = ranks
+        self.ranks = sorted(ranks) if ranks is not None else None
         self.id = group_id
 
     @property
     def nranks(self):
+        if self.ranks is not None:
+            return len(self.ranks)
         return mesh_mod.mesh_axis_size(self.axis)
 
     def get_group_rank(self, rank):
+        if self.ranks is not None:
+            return self.ranks.index(rank) if rank in self.ranks else -1
         return rank
+
+    def index_groups(self):
+        """axis_index_groups partitioning the axis: [members] + singletons.
+        None when the group spans the whole axis."""
+        if self.ranks is None:
+            return None
+        n = mesh_mod.mesh_axis_size(self.axis)
+        if list(self.ranks) == list(range(n)):
+            return None
+        others = [[r] for r in range(n) if r not in self.ranks]
+        return [list(self.ranks)] + others
 
 
 _groups = {0: Group("dp", group_id=0)}
@@ -76,6 +94,10 @@ def _axis_of(group) -> str:
     return "dp"
 
 
+def _groups_of(group):
+    return group.index_groups() if isinstance(group, Group) else None
+
+
 def _in_region(axis):
     return mesh_mod.in_spmd_region(axis)
 
@@ -88,13 +110,66 @@ _REDUCERS = {
 }
 
 
+def _hashable(groups):
+    """axis_index_groups as nested tuples so defop kwargs stay hashable."""
+    if groups is None:
+        return None
+    return tuple(tuple(g) for g in groups)
+
+
+def _identity_for(op, dtype):
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        return jnp.zeros((), dtype)
+    if op == ReduceOp.MAX:
+        if dtype == jnp.bool_:
+            return jnp.asarray(False)  # MAX on bool == OR
+        return jnp.asarray(jnp.finfo(dtype).min
+                           if jnp.issubdtype(dtype, jnp.floating)
+                           else jnp.iinfo(dtype).min, dtype)
+    if op == ReduceOp.MIN:
+        if dtype == jnp.bool_:
+            return jnp.asarray(True)  # MIN on bool == AND
+        return jnp.asarray(jnp.finfo(dtype).max
+                           if jnp.issubdtype(dtype, jnp.floating)
+                           else jnp.iinfo(dtype).max, dtype)
+    return jnp.ones((), dtype)  # PROD
+
+
+def _member_mask(axis, members):
+    idx = lax.axis_index(axis)
+    m = jnp.zeros((), jnp.bool_)
+    for r in members:
+        m = m | (idx == r)
+    return m
+
+
 @defop(name="c_allreduce")
-def _allreduce_raw(x, axis, op):
+def _allreduce_raw(x, axis, op, groups=None):
+    """All-reduce, optionally over a rank subset.
+
+    Subset semantics (XLA axis_index_groups is unavailable inside shard_map
+    in current JAX): members reduce among themselves via identity-element
+    masking, non-members keep their own value — exactly the
+    [members]+singletons partition a reference sub-communicator gives."""
+    members = list(groups[0]) if groups else None
     if op == ReduceOp.PROD:
-        logs = lax.psum(jnp.log(jnp.abs(x) + 1e-30), axis)
-        sign = lax.psum(jnp.where(x < 0, 1, 0), axis) % 2
-        return jnp.where(sign == 1, -jnp.exp(logs), jnp.exp(logs))
-    return _REDUCERS[op](x, axis)
+        # exact product (zeros/signs included): gather the axis, reduce
+        # locally. Reference c_allreduce_prod is a real ncclProd; XLA has no
+        # product all-reduce, and the log/exp trick misreduces zeros.
+        g = lax.all_gather(x, axis)
+        if members is None:
+            return jnp.prod(g, axis=0)
+        red = jnp.prod(g[jnp.asarray(members)], axis=0)
+        return jnp.where(_member_mask(axis, members), red, x)
+    if members is None:
+        return _REDUCERS[op](x, axis)
+    mask = _member_mask(axis, members)
+    masked = jnp.where(mask, x, _identity_for(op, x.dtype))
+    if op == ReduceOp.AVG:
+        red = lax.psum(masked, axis) / len(members)
+    else:
+        red = _REDUCERS[op](masked, axis)
+    return jnp.where(mask, red.astype(x.dtype), x)
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -106,7 +181,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             f"all_reduce over axis '{axis}' called outside an SPMD region; "
             "wrap the computation in paddle_tpu.distributed.shard (shard_map)"
             " or use sharded training via fleet/Model.fit")
-    out = _allreduce_raw(tensor, axis=axis, op=op)
+    out = _allreduce_raw(tensor, axis=axis, op=op,
+                         groups=_hashable(_groups_of(group)))
     if isinstance(tensor, Tensor):
         tensor._rebind(out)  # paddle mutates in place
         return tensor
@@ -139,8 +215,8 @@ def all_gather_object(obj_list, obj, group=None):
 
 
 @defop(name="c_reduce")
-def _reduce_raw(x, axis, op, dst):
-    red = _REDUCERS[op](x, axis)
+def _reduce_raw(x, axis, op, dst, groups=None):
+    red = _allreduce_raw.raw(x, axis, op, groups)
     idx = lax.axis_index(axis)
     return jnp.where(idx == dst, red, x)
 
@@ -151,7 +227,8 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
         if mesh_mod.mesh_axis_size(axis) == 1:
             return tensor
         raise RuntimeError("reduce outside SPMD region")
-    out = _reduce_raw(tensor, axis=axis, op=op, dst=dst)
+    out = _reduce_raw(tensor, axis=axis, op=op, dst=dst,
+                      groups=_hashable(_groups_of(group)))
     if isinstance(tensor, Tensor):
         tensor._rebind(out)
         return tensor
@@ -159,10 +236,33 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 @defop(name="c_broadcast")
-def _broadcast_raw(x, axis, src):
+def _broadcast_raw(x, axis, src, members=None):
+    """Butterfly broadcast: log2(n) collective_permute rounds, so the source
+    link is never an O(n) hotspot and any dtype (incl. bool/int) is exact —
+    replaces the psum(x*mask) trick. Non-member ranks of a subset group keep
+    their own value."""
     n = mesh_mod.mesh_axis_size(axis)
-    mask = (lax.axis_index(axis) == src).astype(x.dtype)
-    return lax.psum(x * mask, axis)
+    members = list(members) if members is not None else list(range(n))
+    m = len(members)
+    if m == 1:
+        return x
+    src_pos = members.index(src)
+    ring = [members[(src_pos + i) % m] for i in range(m)]  # pos->rank
+    # pos of this rank in the member ring (-1 for non-members), statically
+    # tabulated and indexed by the dynamic axis index
+    pos_np = np.full((n,), -1, np.int32)
+    for j, r in enumerate(ring):
+        pos_np[r] = j
+    pos = jnp.asarray(pos_np)[lax.axis_index(axis)]
+    stride = 1
+    while stride < m:
+        perm = tuple((ring[i], ring[i + stride])
+                     for i in range(stride) if i + stride < m)
+        recv = lax.ppermute(x, axis, perm)
+        newly = (pos >= stride) & (pos < 2 * stride)
+        x = jnp.where(newly, recv, x)
+        stride *= 2
+    return x
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
@@ -171,7 +271,9 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         if mesh_mod.mesh_axis_size(axis) == 1:
             return tensor
         raise RuntimeError("broadcast outside SPMD region")
-    out = _broadcast_raw(tensor, axis=axis, src=src)
+    members = tuple(group.ranks) if isinstance(group, Group) and \
+        group.ranks is not None else None
+    out = _broadcast_raw(tensor, axis=axis, src=src, members=members)
     if isinstance(tensor, Tensor):
         tensor._rebind(out)
         return tensor
@@ -180,7 +282,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 @defop(name="c_scatter")
 def _scatter_raw(stacked, axis, src):
-    full = _broadcast_raw(stacked, axis, src)
+    full = _broadcast_raw.raw(stacked, axis, src, None)
     idx = lax.axis_index(axis)
     return lax.dynamic_index_in_dim(full, idx, axis=0, keepdims=False)
 
@@ -231,7 +333,23 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 
 @defop(name="c_reducescatter")
 def _reduce_scatter_raw(x, axis, op):
-    return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        out = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+        if op == ReduceOp.AVG:
+            out = out / mesh_mod.mesh_axis_size(axis)
+        return out
+    # MAX/MIN/PROD: no fused XLA reduce-scatter variant — reduce over the
+    # axis then slice this rank's chunk (reference c_reducescatter supports
+    # all ncclRedOps; silent SUM here would be a wrong answer).
+    n = mesh_mod.mesh_axis_size(axis)
+    if x.shape[0] % n != 0:
+        raise ValueError(
+            f"reduce_scatter: leading dim {x.shape[0]} not divisible by "
+            f"axis '{axis}' size {n}")
+    red = _allreduce_raw.raw(x, axis, op, None)
+    chunk = x.shape[0] // n
+    idx = lax.axis_index(axis)
+    return lax.dynamic_slice_in_dim(red, idx * chunk, chunk, axis=0)
 
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
@@ -287,11 +405,8 @@ def barrier(group=None):
     Single-controller SPMD needs no in-graph barrier; multi-host sync goes
     through the jax distributed runtime."""
     if get_world_size() > 1:
-        try:
-            from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices("paddle_tpu_barrier")
-        except Exception:
-            pass
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
 
 
 def wait(tensor, group=None, use_calc_stream=True):

@@ -243,6 +243,11 @@ class _CompiledEngine:
                 jnp.asarray(opt.get_lr(), jnp.float32),
                 jnp.asarray(opt._step_count, jnp.int32),
                 _rng.next_key(), raw_in, raw_lab)
+            from ..core import flags as _flags
+            if _flags.flag("FLAGS_check_nan_inf"):
+                from ..core.numeric_check import sweep
+                sweep({"loss": lval, "params": new_params},
+                      "train_batch step")
             self._write_back(new_params, new_bufs)
             opt._slots.update(new_slots)
             return lval, outs
@@ -458,6 +463,8 @@ class Model:
             inputs, _ = self._split_batch(batch, allow_no_label=True)
             outs = self.predict_batch(inputs)
             outputs.append(outs)
+        if not outputs:
+            return []
         # transpose: list of per-batch lists -> per-output lists
         n_out = len(outputs[0])
         merged = [[b[i] for b in outputs] for i in range(n_out)]

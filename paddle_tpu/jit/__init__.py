@@ -38,33 +38,57 @@ class StaticFunction:
         self._input_spec = input_spec
         self._compiled = {}
 
-    def _key(self, args, kwargs):
+    @staticmethod
+    def _is_arraylike(v):
+        import jax
+        return isinstance(v, (Tensor, np.ndarray, jax.Array))
+
+    def _key(self, args, kw_tree, kw_leaves):
+        # arrays are keyed by (shape, dtype) — they are traced inputs, never
+        # baked constants; only hashable non-array leaves key by value
         def one(a):
-            if isinstance(a, Tensor):
-                return (tuple(a.shape), str(a.dtype))
+            if self._is_arraylike(a):
+                shape = tuple(a.shape) if hasattr(a, "shape") \
+                    else tuple(np.shape(a))
+                return ("arr", shape, str(np.asarray(
+                    a._value if isinstance(a, Tensor) else a).dtype))
             try:
                 hash(a)
                 return ("lit", a)
             except TypeError:
                 return ("lit", repr(a))
-        return (tuple(one(a) for a in args),
-                tuple(sorted((k, one(v)) for k, v in kwargs.items())))
+        return (tuple(one(a) for a in args), kw_tree,
+                tuple(one(v) for v in kw_leaves))
 
     def __call__(self, *args, **kwargs):
         import jax
         import jax.tree_util as jtu
 
-        key = self._key(args, kwargs)
+        # Every array-like kwarg leaf (Tensor, np.ndarray, jax.Array — at
+        # any nesting depth) is a traced input; anything else is a
+        # compile-time literal captured in the cache key.
+        is_t = lambda v: isinstance(v, Tensor)  # noqa: E731
+        kw_leaves, kw_tree = jtu.tree_flatten(kwargs, is_leaf=is_t)
+        traced_idx = tuple(i for i, v in enumerate(kw_leaves)
+                           if self._is_arraylike(v))
+        wrap_tensor = tuple(isinstance(kw_leaves[i], Tensor)
+                            for i in traced_idx)
+        key = self._key(args, kw_tree, kw_leaves)
         if key not in self._compiled:
             target = self._layer if self._layer is not None else self._fn
             is_layer = self._layer is not None
+            lit_leaves = list(kw_leaves)  # traced slots overwritten per call
 
-            def pure(params, buffers, raw_args):
+            def pure(params, buffers, raw_args, traced_vals):
+                leaves = list(lit_leaves)
+                for i, v, as_t in zip(traced_idx, traced_vals, wrap_tensor):
+                    leaves[i] = Tensor(v, _internal=True) if as_t else v
+                kw = jtu.tree_unflatten(kw_tree, leaves)
                 with _tape.no_grad():
                     if is_layer:
                         target.load_functional_state(params, buffers)
                     tin = [Tensor(a, _internal=True) for a in raw_args]
-                    out = target(*tin, **kwargs)
+                    out = target(*tin, **kw)
                     # thread mutated buffers (BN running stats) back out
                     new_bufs = ({n: b._value for n, b in
                                  target.named_buffers()} if is_layer else {})
@@ -78,7 +102,10 @@ class StaticFunction:
         params, buffers = ({}, {}) if self._layer is None \
             else self._layer.functional_state()
         raw = tuple(a._value if isinstance(a, Tensor) else a for a in args)
-        out, new_bufs = self._compiled[key](params, buffers, raw)
+        traced_vals = tuple(
+            kw_leaves[i]._value if isinstance(kw_leaves[i], Tensor)
+            else kw_leaves[i] for i in traced_idx)
+        out, new_bufs = self._compiled[key](params, buffers, raw, traced_vals)
         if self._layer is not None:
             self._layer.load_functional_state(params, buffers)
             self._layer.load_functional_state(None, new_bufs)

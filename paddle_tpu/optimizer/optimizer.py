@@ -158,7 +158,11 @@ class Optimizer:
                 return self.apply_gradients_pure(params, grads, slots, lr, t,
                                                  param_meta=meta)
 
-            self._step_fn = jax.jit(step_fn, donate_argnums=(0, 2))
+            # donate only the slots: a retained grad graph
+            # (backward(retain_graph=True)) may still reference the live
+            # parameter buffers, so donating argnum 0 would let a later
+            # backward read deleted storage
+            self._step_fn = jax.jit(step_fn, donate_argnums=(2,))
             self._step_fn_sig = sig
         return self._step_fn
 

@@ -131,6 +131,12 @@ class Executor:
             tuple(feed_vals[n] for n in sorted(feed_vals)), scope_vals,
             slots, lr, t, _rng.next_key())
 
+        from ..core import flags as _flags
+        if _flags.flag("FLAGS_check_nan_inf"):
+            from ..core.numeric_check import sweep
+            sweep({"fetches": list(fetches), "scope": new_scope},
+                  "Executor.run step")
+
         for n, v in new_scope.items():
             scope.set(n, v)
         if opt is not None:
@@ -174,49 +180,53 @@ class Executor:
 
         def step(feed_tuple, scope_vals, slots, lr, t, key):
             from ..core import rng as _rng
-            with _rng.rng_state(key):
-                env = {}
-                for name, val in zip(sorted(feed_names), feed_tuple):
-                    env[data_ids[name]] = val
-                for name, vid in persist:
-                    env[vid] = scope_vals[name]
-                env = run_ops(dict(env))
 
-                new_slots = slots
-                if bwd is not None:
-                    loss_var, pairs = bwd
-                    grad_names = [p.scope_name for p, _ in pairs]
+            # ONE forward pass. With a backward section, fetches come out of
+            # the grad pass's own forward (has_aux) so stochastic ops (e.g.
+            # dropout) use exactly the keys the applied gradient saw — the
+            # chain is re-seated on `key` inside `forward` either way.
+            def forward(pvals):
+                with _rng.rng_state(key):
+                    env = {}
+                    for name, val in zip(sorted(feed_names), feed_tuple):
+                        env[data_ids[name]] = val
+                    for name, vid in persist:
+                        env[vid] = (scope_vals[name] if pvals is None
+                                    else pvals.get(name, scope_vals[name]))
+                    return run_ops(env)
 
-                    def loss_of(pvals):
-                        env2 = {}
-                        for name, val in zip(sorted(feed_names), feed_tuple):
-                            env2[data_ids[name]] = val
-                        for name, vid in persist:
-                            env2[vid] = pvals.get(name, scope_vals[name])
-                        env2 = run_ops(env2)
-                        return env2[loss_var.var_id]
+            new_slots = slots
+            if bwd is not None:
+                loss_var, pairs = bwd
+                grad_names = [p.scope_name for p, _ in pairs]
 
-                    grads = jax.grad(loss_of)(
-                        {n: scope_vals[n] for n in grad_names})
-                    for p, g in pairs:
-                        env[g.var_id] = grads[p.scope_name]
-                    if opt is not None:
-                        pvals = {n: scope_vals[n] for n in grad_names}
-                        new_p, new_slots = opt.apply_gradients_pure(
-                            pvals, grads, slots, lr, t, param_meta=meta)
-                        for n, v in new_p.items():
-                            env[("param", n)] = v
+                def loss_of(pvals):
+                    env2 = forward(pvals)
+                    return env2[loss_var.var_id], env2
 
-                # every donated scope array must flow back out (unchanged
-                # entries alias through) or the next run reads deleted buffers
-                new_scope = {n: env[vid] for n, vid in persist}
-                for n, vid in state_writes.items():
-                    new_scope[n] = env[vid]
-                if opt is not None and bwd is not None:
-                    for p, _ in opt_sec[1]:
-                        new_scope[p.scope_name] = env[("param", p.scope_name)]
-                fetches = tuple(env[fid] for fid in fetch_ids)
-                return fetches, new_scope, new_slots
+                grads, env = jax.grad(loss_of, has_aux=True)(
+                    {n: scope_vals[n] for n in grad_names})
+                for p, g in pairs:
+                    env[g.var_id] = grads[p.scope_name]
+                if opt is not None:
+                    pvals = {n: scope_vals[n] for n in grad_names}
+                    new_p, new_slots = opt.apply_gradients_pure(
+                        pvals, grads, slots, lr, t, param_meta=meta)
+                    for n, v in new_p.items():
+                        env[("param", n)] = v
+            else:
+                env = forward(None)
+
+            # every donated scope array must flow back out (unchanged
+            # entries alias through) or the next run reads deleted buffers
+            new_scope = {n: env[vid] for n, vid in persist}
+            for n, vid in state_writes.items():
+                new_scope[n] = env[vid]
+            if opt is not None and bwd is not None:
+                for p, _ in opt_sec[1]:
+                    new_scope[p.scope_name] = env[("param", p.scope_name)]
+            fetches = tuple(env[fid] for fid in fetch_ids)
+            return fetches, new_scope, new_slots
 
         # donating the scope only pays off when the step writes it back
         donate = (1, 2) if (state_writes or opt is not None) else ()

@@ -1,0 +1,183 @@
+"""Round-2 fix coverage: collective semantics, nan/inf sweep, grad seeding,
+jit kwargs, dropout fast path.
+
+Models the reference's numeric collective checks (test_collective_base.py)
+and nan/inf debugging tests (details/nan_inf_utils_detail.*)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import collective, mesh as mesh_mod
+
+
+@pytest.fixture
+def mesh8():
+    yield mesh_mod.init_mesh({"dp": 8})
+
+
+def test_allreduce_prod_with_zeros(mesh8):
+    # the log/exp trick yields a tiny nonzero for zero products; the
+    # gather-based PROD must return exactly 0
+    x = jnp.asarray([0.0, 2.0, 3.0, 1.0, -1.0, 1.0, 1.0, 2.0])
+
+    def body(xl):
+        return collective._allreduce_raw(xl, axis="dp",
+                                         op=collective.ReduceOp.PROD)
+
+    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+                        out_specs=P("dp"))(x)
+    expect = np.prod(np.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), np.full(8, expect))
+    assert float(np.asarray(out)[0]) == 0.0
+
+
+def test_allreduce_prod_negative(mesh8):
+    x = jnp.asarray([-2.0, 2.0, 1.0, 1.0, -1.0, 1.0, 1.0, -3.0])
+
+    def body(xl):
+        return collective._allreduce_raw(xl, axis="dp",
+                                         op=collective.ReduceOp.PROD)
+
+    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+                        out_specs=P("dp"))(x)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full(8, np.prod(np.asarray(x))))
+
+
+def test_reduce_scatter_max(mesh8):
+    # op must be honored, not silently SUM-reduced
+    x = jnp.arange(64.0).reshape(8, 8)
+
+    def body(xl):
+        return collective._reduce_scatter_raw(
+            xl[0], axis="dp", op=collective.ReduceOp.MAX)[None]
+
+    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+                        out_specs=P("dp"))(x)
+    np.testing.assert_allclose(np.asarray(out).reshape(-1),
+                               np.asarray(x).max(axis=0))
+
+
+def test_broadcast_bool_dtype(mesh8):
+    # psum-mask broadcast broke on bool; ppermute multicast must not
+    x = jnp.asarray([True, False, True, False, True, False, True, False])
+
+    def body(xl):
+        return collective._broadcast_raw(xl, axis="dp", src=2)
+
+    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+                        out_specs=P("dp"))(x)
+    assert np.asarray(out).dtype == np.bool_
+    np.testing.assert_array_equal(np.asarray(out), np.full(8, True))
+
+
+def test_subgroup_allreduce(mesh8):
+    # new_group over a rank subset: members reduce among themselves,
+    # non-members keep their value (singleton groups)
+    x = jnp.arange(8.0)
+    g = collective.new_group(ranks=[0, 1, 2, 3])
+    assert g.nranks == 4
+    assert g.get_group_rank(2) == 2 and g.get_group_rank(7) == -1
+
+    def body(xl):
+        return collective._allreduce_raw(
+            xl, axis="dp", op=collective.ReduceOp.SUM,
+            groups=collective._hashable(g.index_groups()))
+
+    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+                        out_specs=P("dp"))(x)
+    expect = np.asarray([6.0, 6.0, 6.0, 6.0, 4.0, 5.0, 6.0, 7.0])
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_subgroup_broadcast(mesh8):
+    x = jnp.arange(8.0)
+
+    def body(xl):
+        return collective._broadcast_raw(xl, axis="dp", src=1,
+                                         members=(1, 5, 6))
+
+    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+                        out_specs=P("dp"))(x)
+    expect = np.asarray([0.0, 1.0, 2.0, 3.0, 4.0, 1.0, 1.0, 7.0])
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_check_nan_inf_eager_op():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor([1.0, 0.0])
+        with pytest.raises(RuntimeError, match="nan"):
+            _ = paddle.ops.log(x - 1.0)  # log(0), log(-1) -> -inf, nan
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_check_nan_inf_off_by_default():
+    x = paddle.to_tensor([0.0])
+    out = paddle.ops.log(x)  # -inf, no raise
+    assert np.isneginf(out.numpy()).all()
+
+
+def test_grad_output_is_input_sums_seed():
+    # grad(outputs=[x, y], inputs=[x]) with y = f(x): dx must be
+    # seed(identity) + df/dx, not just the path gradient
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = x * x  # dy/dx = 2x
+    gx, = paddle.grad([x, y], [x], retain_graph=True)
+    np.testing.assert_allclose(gx.numpy(), 1.0 + 2.0 * np.asarray([2.0, 3.0]))
+
+
+def test_grad_nonleaf_output_is_input():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    h = x * 3.0          # non-leaf
+    y = h * h            # dy/dh = 2h
+    gh, = paddle.grad([h, y], [h], retain_graph=True)
+    np.testing.assert_allclose(gh.numpy(), 1.0 + 2.0 * 3.0 * np.asarray([1.0, 2.0]))
+
+
+def test_jit_tensor_kwarg_not_baked():
+    from paddle_tpu import jit
+
+    def f(x, bias=None):
+        return x + bias
+
+    sf = jit.to_static(f)
+    x = paddle.to_tensor([1.0, 1.0])
+    b1 = paddle.to_tensor([10.0, 10.0])
+    b2 = paddle.to_tensor([20.0, 20.0])  # same shape/dtype, different value
+    out1 = sf(x, bias=b1)
+    out2 = sf(x, bias=b2)
+    np.testing.assert_allclose(out1.numpy(), [11.0, 11.0])
+    np.testing.assert_allclose(out2.numpy(), [21.0, 21.0])
+
+
+def test_dropout_p1_zeroes():
+    x = paddle.ones([8, 8])
+    out = paddle.ops.dropout(x, p=1.0, training=True)
+    assert float(out.sum()) == 0.0
+
+
+def test_dropout_statistics_and_scaling():
+    x = paddle.ones([256, 256])
+    out = paddle.ops.dropout(x, p=0.25, training=True)
+    arr = out.numpy()
+    keep_frac = (arr != 0).mean()
+    assert abs(keep_frac - 0.75) < 0.02
+    # upscale_in_train: kept values are x / keep
+    np.testing.assert_allclose(arr[arr != 0], 1.0 / 0.75, rtol=1e-6)
+
+
+def test_predict_empty_loader():
+    from paddle_tpu import nn
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.static import InputSpec
+
+    net = nn.Linear(4, 2)
+    m = Model(net, inputs=[InputSpec([None, 4], "float32", "x")])
+    m.prepare()
+    assert m.predict([], batch_size=2) == []
