@@ -40,14 +40,14 @@ def main():
     net = Bert(cfg)
     net.train()
     criterion = BertPretrainingCriterion(cfg.vocab_size)
+    # honest O2 AMP recipe: bf16 params/compute with f32 master weights +
+    # f32 moments in the optimizer (paddle_tpu.amp.decorate semantics)
     optimizer = opt_mod.AdamW(learning_rate=1e-4,
-                              parameters=net.parameters())
+                              parameters=net.parameters(),
+                              multi_precision=(DTYPE == "bfloat16"))
 
     params, buffers = net.functional_state()
     if DTYPE == "bfloat16":
-        # bf16 params + bf16 compute, f32 MXU accumulation (ops/linalg.py);
-        # optimizer runs on the bf16 master copy this round (true master-
-        # weight AMP lands with paddle_tpu.amp O2).
         params = {k: v.astype(jnp.bfloat16) if v.dtype == jnp.float32 else v
                   for k, v in params.items()}
     named = dict(net.named_parameters())
@@ -71,12 +71,13 @@ def main():
 
     step = jax.jit(train_step, donate_argnums=(0, 1))
 
+    # int32 ids/labels: TPUs index natively in int32; int64 costs a widen
     rng = np.random.RandomState(0)
-    ids = jnp.asarray(rng.randint(4, cfg.vocab_size, (BATCH, SEQ)), jnp.int64)
+    ids = jnp.asarray(rng.randint(4, cfg.vocab_size, (BATCH, SEQ)), jnp.int32)
     mask = rng.rand(BATCH, SEQ) < 0.15
     labels = jnp.asarray(np.where(mask, rng.randint(4, cfg.vocab_size,
                                                     (BATCH, SEQ)), -100),
-                         jnp.int64)
+                         jnp.int32)
     lr = jnp.asarray(1e-4, jnp.float32)
     key = jax.random.PRNGKey(0)
 

@@ -1,0 +1,340 @@
+"""Automatic mixed precision.
+
+Parity targets:
+- `paddle.amp.auto_cast` — reference python/paddle/amp/auto_cast.py
+  (op allow/deny lists, O1/O2 levels), applied per-op by the imperative
+  tracer (reference paddle/fluid/imperative/tracer.cc:84-87).
+- `paddle.amp.GradScaler` — reference python/paddle/amp/grad_scaler.py with
+  the device-side semantics of operators/amp/check_finite_and_unscale_op.cc
+  and update_loss_scaling_op.cc.
+- master weights — reference multi_precision paths in
+  operators/optimizers/adam_op.cu etc. (here: an f32 "master" optimizer
+  slot, see optimizer/optimizer.py).
+
+TPU design delta: bfloat16 is the native compute dtype (MXU), so the
+default amp dtype is bf16 and loss scaling is OPTIONAL for bf16 (its
+exponent range matches f32); the scaler degrades to a plain pass-through
+when scaling is disabled, exactly like the reference's enable=False mode.
+The per-op cast hook lives in core/tape.record_op — the single dispatch
+point all three frontends (eager, jitted step, static Program) share.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "decorate",
+           "white_list", "black_list"]
+
+# -- op lists (analog of fp16_lists.py AutoMixedPrecisionLists) --------------
+# MXU-bound ops: always worth bf16
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "einsum", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "linear", "addmm",
+}
+# numerically sensitive ops: keep f32 inputs
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "sigmoid_cross_entropy_with_logits", "kl_div", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "huber_loss", "mean", "sum", "prod", "cumsum",
+    "logsumexp", "norm", "p_norm", "erf", "erfinv", "expm1", "sigmoid",
+    "cosine_similarity", "softplus", "layer_norm", "batch_norm",
+    "instance_norm", "group_norm", "rms_norm", "local_response_norm",
+}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = jnp.bfloat16
+        self.white = frozenset(WHITE_LIST)
+        self.black = frozenset(BLACK_LIST)
+
+
+_state = _AmpState()
+
+
+def policy_dtype(name, level, dtype, white=None, black=None):
+    """Target dtype for op `name`'s floating inputs under (level, dtype),
+    or None to leave them as-is. Shared by the eager auto_cast state and the
+    static executor's program-level AMP."""
+    black = black if black is not None else BLACK_LIST
+    white = white if white is not None else WHITE_LIST
+    if name in black:
+        return jnp.float32
+    if level == "O2":
+        return dtype
+    if name in white:
+        return dtype
+    return None  # O1 gray ops: run in whatever dtype arrives
+
+
+def _amp_dtype_of(name: str):
+    if not _state.enabled:
+        return None
+    return policy_dtype(name, _state.level, _state.dtype,
+                        _state.white, _state.black)
+
+
+def cast_vals(name, vals, level, dtype, white=None, black=None):
+    """Static-graph form of cast_inputs: explicit policy, no thread state."""
+    dt = policy_dtype(name, level, dtype, white, black)
+    if dt is None:
+        return vals
+    return _cast_list(vals, dt)
+
+
+def amp_active() -> bool:
+    return _state.enabled
+
+
+def _cast_list(vals, dt):
+    """Cast every floating array in `vals` to dt (shared by the eager and
+    static cast paths so the predicate can't diverge)."""
+    return [v.astype(dt) if hasattr(v, "dtype")
+            and jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != dt
+            else v for v in vals]
+
+
+def cast_inputs(op_name: str, vals):
+    """Called inside record_op's differentiated region: cast floating array
+    inputs per the active policy. The cast is part of the traced function,
+    so its vjp re-casts cotangents back to the source dtype (f32 params
+    receive f32 grads)."""
+    dt = _amp_dtype_of(op_name)
+    if dt is None:
+        return vals
+    return _cast_list(vals, dt)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """reference python/paddle/amp/auto_cast.py auto_cast/amp_guard."""
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"amp level must be O0/O1/O2, got {level!r}")
+    prev = (_state.enabled, _state.level, _state.dtype, _state.white,
+            _state.black)
+    _state.enabled = bool(enable) and level != "O0"
+    _state.level = level
+    _state.dtype = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") \
+        else jnp.float16
+    white = set(WHITE_LIST)
+    black = set(BLACK_LIST)
+    if custom_white_list:
+        white |= set(custom_white_list)
+        black -= set(custom_white_list)
+    if custom_black_list:
+        black |= set(custom_black_list)
+        white -= set(custom_black_list)
+    _state.white = frozenset(white)
+    _state.black = frozenset(black)
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.level, _state.dtype, _state.white,
+         _state.black) = prev
+
+
+amp_guard = auto_cast  # legacy alias (dygraph/amp/auto_cast.py amp_guard)
+
+
+# -- loss scaling ------------------------------------------------------------
+
+def check_finite_and_unscale(grads: dict, scale):
+    """Pure analog of operators/amp/check_finite_and_unscale_op.cc:
+    (grads, scale) -> (unscaled_grads, found_inf[bool scalar])."""
+    inv = (1.0 / scale).astype(jnp.float32)
+    found = jnp.zeros((), jnp.bool_)
+    out = {}
+    for k, g in grads.items():
+        gf = g.astype(jnp.float32) * inv
+        found = found | ~jnp.isfinite(gf).all()
+        out[k] = gf.astype(g.dtype)
+    return out, found
+
+
+def update_loss_scaling(scale, good_steps, bad_steps, found_inf, *,
+                        incr_ratio, decr_ratio, incr_every_n_steps,
+                        decr_every_n_nan_or_inf):
+    """Pure analog of operators/amp/update_loss_scaling_op.cc."""
+    good = jnp.where(found_inf, 0, good_steps + 1)
+    bad = jnp.where(found_inf, bad_steps + 1, 0)
+    grow = good >= incr_every_n_steps
+    shrink = bad >= decr_every_n_nan_or_inf
+    new_scale = jnp.where(
+        shrink, jnp.maximum(scale * decr_ratio, 1.0),
+        jnp.where(grow, scale * incr_ratio, scale))
+    good = jnp.where(grow | shrink, 0, good)
+    bad = jnp.where(shrink, 0, bad)
+    return new_scale.astype(jnp.float32), good.astype(jnp.int32), \
+        bad.astype(jnp.int32)
+
+
+class GradScaler:
+    """reference python/paddle/amp/grad_scaler.py.
+
+    Eager usage:
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024)
+        with paddle.amp.auto_cast():
+            loss = model(x)
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(optimizer)   # unscale + skip-if-nonfinite + opt.step
+        scaler.update()
+
+    The same state drives the pure `scale_state()`/`apply_pure()` form that
+    hapi/static compiled steps embed (one fused XLA program per step).
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = bool(enable)
+        self._scale = jnp.asarray(float(init_loss_scaling), jnp.float32)
+        self._good = jnp.asarray(0, jnp.int32)
+        self._bad = jnp.asarray(0, jnp.int32)
+        self._incr_ratio = float(incr_ratio)
+        self._decr_ratio = float(decr_ratio)
+        self._incr_every_n_steps = int(incr_every_n_steps)
+        self._decr_every_n_nan_or_inf = int(decr_every_n_nan_or_inf)
+        self._dynamic = bool(use_dynamic_loss_scaling)
+        self._found_inf = None  # set by unscale_/step
+
+    # -- eager path ----------------------------------------------------------
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return float(np.asarray(self._scale))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = jnp.asarray(float(v), jnp.float32)
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * Tensor(self._scale, _internal=True)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        named = optimizer._collect()
+        grads = {k: p.grad._value for k, p in named.items()}
+        new_grads, found = check_finite_and_unscale(grads, self._scale)
+        for k, p in named.items():
+            p.grad = Tensor(new_grads[k], stop_gradient=True, _internal=True)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._found_inf is None:
+            self.unscale_(optimizer)
+        if not bool(np.asarray(self._found_inf)):
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        # reference: scaler.minimize == step + update (loss already backward)
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            self._found_inf = None
+            return
+        if self._found_inf is None:
+            return
+        self._scale, self._good, self._bad = update_loss_scaling(
+            self._scale, self._good, self._bad, self._found_inf,
+            incr_ratio=self._incr_ratio, decr_ratio=self._decr_ratio,
+            incr_every_n_steps=self._incr_every_n_steps,
+            decr_every_n_nan_or_inf=self._decr_every_n_nan_or_inf)
+        self._found_inf = None
+
+    # -- pure path (embedded in compiled train steps) ------------------------
+    def scale_state(self):
+        return {"scale": self._scale, "good": self._good, "bad": self._bad}
+
+    def load_scale_state(self, st):
+        self._scale, self._good, self._bad = st["scale"], st["good"], st["bad"]
+
+    def apply_pure(self, grads, state):
+        """(scaled_grads, state) -> (unscaled_grads, found_inf, new_state).
+        Embed inside a jitted step; caller gates the param update on
+        found_inf (select old params when non-finite)."""
+        if not self._enable:
+            return grads, jnp.zeros((), jnp.bool_), state
+        new_grads, found = check_finite_and_unscale(grads, state["scale"])
+        if self._dynamic:
+            s, g, b = update_loss_scaling(
+                state["scale"], state["good"], state["bad"], found,
+                incr_ratio=self._incr_ratio, decr_ratio=self._decr_ratio,
+                incr_every_n_steps=self._incr_every_n_steps,
+                decr_every_n_nan_or_inf=self._decr_every_n_nan_or_inf)
+            state = {"scale": s, "good": g, "bad": b}
+        return new_grads, found, state
+
+    def state_dict(self):
+        return {
+            "scale": np.asarray(self._scale),
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_count": int(np.asarray(self._good)),
+            "decr_count": int(np.asarray(self._bad)),
+            "use_dynamic_loss_scaling": self._dynamic,
+        }
+
+    def set_state_dict(self, d):
+        self._scale = jnp.asarray(d["scale"], jnp.float32)
+        self._good = jnp.asarray(d.get("incr_count", 0), jnp.int32)
+        self._bad = jnp.asarray(d.get("decr_count", 0), jnp.int32)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """reference python/paddle/amp/auto_cast.py decorate (O2 pure-bf16):
+    cast model params to the amp dtype; optimizer keeps f32 master weights
+    (multi_precision slot)."""
+    if level not in ("O1", "O2"):
+        raise ValueError("decorate level must be O1 or O2")
+    amp_dt = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") \
+        else jnp.float16
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            for p in m.parameters():
+                if jnp.issubdtype(p._value.dtype, jnp.floating) \
+                        and p._value.dtype == jnp.float32:
+                    p._value = p._value.astype(amp_dt)
+                    p._node = None
+    if optimizers is None:
+        return models
+    opt_single = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if opt_single else list(optimizers)
+    for opt in opt_list:
+        if master_weight is not False:
+            opt._multi_precision = True
+    return models, optimizers

@@ -29,8 +29,8 @@ def enabled() -> bool:
 def _is_concrete_float(v):
     if isinstance(v, jax.core.Tracer):
         return False
-    return hasattr(v, "dtype") and np.issubdtype(np.dtype(v.dtype),
-                                                 np.floating)
+    import jax.numpy as jnp
+    return hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating)
 
 
 def check_op_outputs(op_name: str, out_val):

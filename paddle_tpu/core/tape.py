@@ -27,6 +27,7 @@ import threading
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from . import flags as _flags
@@ -133,12 +134,23 @@ def record_op(fn: Callable, args: Sequence[Any], kwargs: dict, name: str = None)
     def _diffable(a):
         v = a._value
         dt = v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype
-        return not a.stop_gradient and np.issubdtype(dt, np.inexact)
+        # jnp.issubdtype, not np: bfloat16 is an ml_dtypes extension that
+        # numpy's lattice calls non-inexact, which would silently freeze
+        # bf16 params out of autograd
+        return not a.stop_gradient and jnp.issubdtype(dt, jnp.inexact)
 
     diff_idx = [i for i, a in enumerate(flat)
                 if is_t(a) and _diffable(a)] if is_grad_enabled() else []
 
     def _call(full):
+        # AMP: cast inside the differentiated region (analog of the
+        # reference tracer's per-op auto-cast, imperative/tracer.cc:84-87) so
+        # the cast's vjp returns cotangents in the source dtype
+        import sys
+        amp = sys.modules.get("paddle_tpu.amp")
+        if amp is not None and amp.amp_active():
+            full = amp.cast_inputs(name or getattr(fn, "__name__", "op"),
+                                   full)
         kw = jax.tree_util.tree_unflatten(kw_tree, full[n_args:])
         return fn(*full[:n_args], **kw)
 
@@ -237,8 +249,9 @@ def _wrap_outputs(out_val, node, stop_gradient):
 
     def wrap_one(v, idx):
         sg = stop_gradient
-        if hasattr(v, "dtype") and not np.issubdtype(v.dtype, np.inexact):
-            sg = True  # integer/bool outputs never carry grad
+        if hasattr(v, "dtype") and not jnp.issubdtype(v.dtype, jnp.inexact):
+            sg = True  # integer/bool outputs never carry grad (jnp lattice:
+            # bf16/f16 count as inexact, unlike numpy's)
         t = Tensor(v, stop_gradient=sg, _internal=True)
         if node is not None and not sg:
             t._node = node
@@ -251,8 +264,8 @@ def _wrap_outputs(out_val, node, stop_gradient):
 
 
 def _zero_cot(shape, dtype):
-    if np.issubdtype(dtype, np.inexact):
-        import jax.numpy as jnp
+    import jax.numpy as jnp
+    if jnp.issubdtype(dtype, jnp.inexact):
         return jnp.zeros(shape, dtype)
     return np.zeros(shape, jax.dtypes.float0)
 

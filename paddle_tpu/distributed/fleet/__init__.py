@@ -169,12 +169,39 @@ class DistributedOptimizer:
         optimizer._dist_strategy = strategy  # engine reads these
         if strategy.sharding:
             optimizer._zero_dp = True
+        if strategy.amp:
+            # O2/pure-bf16 keeps f32 master weights in the optimizer (the
+            # reference amp meta-optimizer's rewrite, declaratively)
+            level = strategy.amp_configs.get("level", "O1")
+            if level == "O2" or strategy.amp_configs.get("use_pure_bf16"):
+                optimizer._multi_precision = True
 
     def __getattr__(self, item):
         return getattr(self.inner_opt, item)
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        strategy = self.user_defined_strategy
+        if strategy.amp and hasattr(loss, "program"):
+            # static graph: tag the Program so the Executor applies the
+            # per-op cast policy (static/amp.py)
+            import jax.numpy as jnp
+            from ...static.program import default_main_program
+            program = loss.program or default_main_program()
+            cfg = strategy.amp_configs
+            program.amp_level = "O2" if cfg.get("use_pure_bf16") \
+                else cfg.get("level", "O1")
+            program.amp_dtype = jnp.float16 \
+                if str(cfg.get("dtype", "bfloat16")) in ("float16", "fp16") \
+                else jnp.bfloat16
+            if cfg.get("custom_white_list") or cfg.get("custom_black_list"):
+                from ... import amp as amp_mod
+                white = amp_mod.white_list() \
+                    | set(cfg.get("custom_white_list") or ())
+                black = (amp_mod.black_list()
+                         | set(cfg.get("custom_black_list") or ())) \
+                    - set(cfg.get("custom_white_list") or ())
+                program.amp_lists = (frozenset(white), frozenset(black))
         return self.inner_opt.minimize(loss, startup_program, parameters,
                                        no_grad_set)
 

@@ -72,17 +72,29 @@ class _CompiledEngine:
         self._param_names = None
 
     # ---- functional pieces -------------------------------------------------
+    def _amp_ctx(self):
+        import contextlib
+        cfg = self.model._amp_configs
+        if not cfg:
+            return contextlib.nullcontext()
+        from .. import amp as amp_mod
+        return amp_mod.auto_cast(
+            level=cfg["level"], dtype=cfg["dtype"],
+            custom_white_list=cfg.get("custom_white_list"),
+            custom_black_list=cfg.get("custom_black_list"))
+
     def _forward_loss(self, params, buffers, inputs, labels, training):
         net = self.model.network
         net.load_functional_state(params, buffers)
         tin = [Tensor(v, stop_gradient=True, _internal=True) for v in inputs]
-        outs = net(*tin)
-        outs_list = _to_list(outs)
-        loss = None
-        if self.model._loss is not None and labels is not None:
-            tlab = [Tensor(v, stop_gradient=True, _internal=True)
-                    for v in labels]
-            loss = self.model._compute_loss(outs_list, tlab)
+        with self._amp_ctx():
+            outs = net(*tin)
+            outs_list = _to_list(outs)
+            loss = None
+            if self.model._loss is not None and labels is not None:
+                tlab = [Tensor(v, stop_gradient=True, _internal=True)
+                        for v in labels]
+                loss = self.model._compute_loss(outs_list, tlab)
         new_bufs = {n: b._value for n, b in net.named_buffers()}
         raw_outs = [o._value for o in outs_list]
         return loss, raw_outs, new_bufs
@@ -118,8 +130,11 @@ class _CompiledEngine:
         named = {n: p for n, p in net.named_parameters()}
         trainable = {n for n, p in named.items() if not p.stop_gradient}
         meta = opt._param_meta(named)
+        amp_cfg = model._amp_configs
+        scaler = amp_cfg.get("scaler") if amp_cfg else None
 
-        def step(params, buffers, slots, lr, t, key, inputs, labels):
+        def step(params, buffers, slots, lr, t, key, inputs, labels,
+                 scale_state):
             with _rng.rng_state(key), _tape.no_grad():
                 train_p = {k: v for k, v in params.items() if k in trainable}
                 frozen_p = {k: v for k, v in params.items()
@@ -130,15 +145,33 @@ class _CompiledEngine:
                     full.update(tp)
                     loss, raw_outs, new_bufs = self._forward_loss(
                         full, buffers, inputs, labels, True)
-                    return loss._value, (raw_outs, new_bufs)
+                    lv = loss._value
+                    if scaler is not None:
+                        # loss scaling inside the differentiated region
+                        # (reference amp/grad_scaler.py scale())
+                        lv = lv * scale_state["scale"].astype(lv.dtype)
+                    return lv, (raw_outs, new_bufs, loss._value)
 
-                (lval, (outs, new_bufs)), grads = jax.value_and_grad(
+                (_, (outs, new_bufs, lval)), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(train_p)
-                new_train, new_slots = opt.apply_gradients_pure(
-                    train_p, grads, slots, lr, t, param_meta=meta)
+                if scaler is not None:
+                    # check_finite_and_unscale + update_loss_scaling fused
+                    # into the step; non-finite steps keep old params/slots
+                    grads, found, scale_state = scaler.apply_pure(
+                        grads, scale_state)
+                    new_train, new_slots = opt.apply_gradients_pure(
+                        train_p, grads, slots, lr, t, param_meta=meta)
+                    keep = lambda old, new: jnp.where(found, old, new)  # noqa: E731
+                    new_train = jax.tree_util.tree_map(keep, train_p,
+                                                       new_train)
+                    new_slots = jax.tree_util.tree_map(keep, dict(slots),
+                                                       new_slots)
+                else:
+                    new_train, new_slots = opt.apply_gradients_pure(
+                        train_p, grads, slots, lr, t, param_meta=meta)
                 new_params = dict(frozen_p)
                 new_params.update(new_train)
-            return lval, outs, new_bufs, new_params, new_slots
+            return lval, outs, new_bufs, new_params, new_slots, scale_state
 
         plan = self._sharding_plan()
         if plan is None:
@@ -149,21 +182,28 @@ class _CompiledEngine:
                    for k in opt_state}
         buffers_sh = {n: plan["repl"] for n, _ in
                       self.model.network.named_buffers()}
+        scale_sh = jax.tree_util.tree_map(lambda _: plan["repl"],
+                                          {"scale": 0, "good": 0, "bad": 0}) \
+            if scaler is not None else None
         return jax.jit(
             step,
             in_shardings=(plan["param"], buffers_sh, slot_sh, plan["repl"],
                           plan["repl"], plan["repl"], plan["batch"],
-                          plan["batch"]),
+                          plan["batch"], scale_sh),
             donate_argnums=(0, 1, 2))
 
     def _build_grad_fn(self):
         """Forward+backward only — used for gradient accumulation
-        (GradientMergeOptimizer analog, reference fluid/optimizer.py:5004)."""
+        (GradientMergeOptimizer analog, reference fluid/optimizer.py:5004).
+        With a GradScaler the micro-batch loss is scaled, so accumulated
+        grads stay scaled until the apply step unscales them once."""
         net = self.model.network
         named = {n: p for n, p in net.named_parameters()}
         trainable = {n for n, p in named.items() if not p.stop_gradient}
+        amp_cfg = self.model._amp_configs
+        scaler = amp_cfg.get("scaler") if amp_cfg else None
 
-        def gstep(params, buffers, key, inputs, labels):
+        def gstep(params, buffers, key, inputs, labels, scale):
             with _rng.rng_state(key), _tape.no_grad():
                 train_p = {k: v for k, v in params.items() if k in trainable}
                 frozen_p = {k: v for k, v in params.items()
@@ -174,9 +214,12 @@ class _CompiledEngine:
                     full.update(tp)
                     loss, raw_outs, new_bufs = self._forward_loss(
                         full, buffers, inputs, labels, True)
-                    return loss._value, (raw_outs, new_bufs)
+                    lv = loss._value
+                    if scaler is not None:
+                        lv = lv * scale.astype(lv.dtype)
+                    return lv, (raw_outs, new_bufs, loss._value)
 
-                (lval, (outs, new_bufs)), grads = jax.value_and_grad(
+                (_, (outs, new_bufs, lval)), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(train_p)
             return lval, outs, new_bufs, grads
 
@@ -186,15 +229,27 @@ class _CompiledEngine:
         opt = self.model._optimizer
         named = dict(self.model.network.named_parameters())
         meta = opt._param_meta(named)
+        amp_cfg = self.model._amp_configs
+        scaler = amp_cfg.get("scaler") if amp_cfg else None
 
-        def apply(params, slots, grads, lr, t, scale):
-            grads = {k: g * scale for k, g in grads.items()}
+        def apply(params, slots, grads, lr, t, inv_count, scale_state):
+            if scaler is not None:
+                # one unscale+finite-check over the merged grads, then the
+                # same found_inf gating as the fused path
+                grads, found, scale_state = scaler.apply_pure(
+                    grads, scale_state)
+            grads = {k: g * inv_count for k, g in grads.items()}
+            train_p = {k: params[k] for k in grads}
             new_train, new_slots = opt.apply_gradients_pure(
-                {k: params[k] for k in grads}, grads, slots, lr, t,
-                param_meta=meta)
+                train_p, grads, slots, lr, t, param_meta=meta)
+            if scaler is not None:
+                keep = lambda old, new: jnp.where(found, old, new)  # noqa: E731
+                new_train = jax.tree_util.tree_map(keep, train_p, new_train)
+                new_slots = jax.tree_util.tree_map(keep, dict(slots),
+                                                   new_slots)
             new_params = dict(params)
             new_params.update(new_train)
-            return new_params, new_slots
+            return new_params, new_slots, scale_state
 
         return jax.jit(apply, donate_argnums=(0, 1))
 
@@ -237,12 +292,18 @@ class _CompiledEngine:
             # fast path: forward+backward+update fused in one XLA program
             if self._train_fn is None:
                 self._train_fn = self._build_train_fn()
+            amp_cfg = self.model._amp_configs
+            scaler = amp_cfg.get("scaler") if amp_cfg else None
+            scale_state = scaler.scale_state() if scaler is not None else {}
             opt._step_count += 1
-            lval, outs, new_bufs, new_params, new_slots = self._train_fn(
-                params, buffers, slots,
-                jnp.asarray(opt.get_lr(), jnp.float32),
-                jnp.asarray(opt._step_count, jnp.int32),
-                _rng.next_key(), raw_in, raw_lab)
+            lval, outs, new_bufs, new_params, new_slots, scale_state = \
+                self._train_fn(
+                    params, buffers, slots,
+                    jnp.asarray(opt.get_lr(), jnp.float32),
+                    jnp.asarray(opt._step_count, jnp.int32),
+                    _rng.next_key(), raw_in, raw_lab, scale_state)
+            if scaler is not None:
+                scaler.load_scale_state(scale_state)
             from ..core import flags as _flags
             if _flags.flag("FLAGS_check_nan_inf"):
                 from ..core.numeric_check import sweep
@@ -254,10 +315,14 @@ class _CompiledEngine:
 
         # accumulation path: grads summed across micro-batches, applied on
         # the update call (grads averaged by micro-batch count)
+        amp_cfg = self.model._amp_configs
+        scaler = amp_cfg.get("scaler") if amp_cfg else None
         if self._grad_fn is None:
             self._grad_fn = self._build_grad_fn()
+        scale = scaler.scale_state()["scale"] if scaler is not None \
+            else jnp.ones((), jnp.float32)
         lval, outs, new_bufs, grads = self._grad_fn(
-            params, buffers, _rng.next_key(), raw_in, raw_lab)
+            params, buffers, _rng.next_key(), raw_in, raw_lab, scale)
         self._write_back({}, new_bufs)
         self._restore(params, {})
         if self._accum_grads is None:
@@ -271,11 +336,15 @@ class _CompiledEngine:
             if self._apply_fn is None:
                 self._apply_fn = self._build_apply_fn()
             opt._step_count += 1
-            new_params, new_slots = self._apply_fn(
+            scale_state = scaler.scale_state() if scaler is not None else {}
+            new_params, new_slots, scale_state = self._apply_fn(
                 params, slots, self._accum_grads,
                 jnp.asarray(opt.get_lr(), jnp.float32),
                 jnp.asarray(opt._step_count, jnp.int32),
-                jnp.asarray(1.0 / self._accum_count, jnp.float32))
+                jnp.asarray(1.0 / self._accum_count, jnp.float32),
+                scale_state)
+            if scaler is not None:
+                scaler.load_scale_state(scale_state)
             self._write_back(new_params, {})
             opt._slots.update(new_slots)
             self._accum_grads = None
@@ -346,8 +415,54 @@ class Model:
         for m in self._metrics:
             if not isinstance(m, Metric):
                 raise TypeError(f"metrics must be Metric instances, got {m}")
-        self._amp_configs = amp_configs
+        self._amp_configs = self._parse_amp(amp_configs)
         return self
+
+    def _parse_amp(self, amp_configs):
+        """amp_configs: None | 'O1'/'O2' | dict (reference hapi/model.py
+        _check_amp_configs + amp/auto_cast.py). O2 casts parameters to the
+        amp dtype and enables f32 master weights in the optimizer."""
+        if amp_configs is None and self._optimizer is not None:
+            # fleet strategy amp knob reaches the engine declaratively
+            strat = getattr(self._optimizer, "_dist_strategy", None)
+            if strat is not None and getattr(strat, "amp", False):
+                amp_configs = dict(strat.amp_configs)
+                if amp_configs.pop("use_pure_bf16", False):
+                    amp_configs.setdefault("level", "O2")
+        if amp_configs is None:
+            return None
+        from .. import amp as amp_mod
+        if isinstance(amp_configs, str):
+            amp_configs = {"level": amp_configs}
+        cfg = dict(amp_configs)
+        level = cfg.get("level", "O1")
+        if level == "O0":
+            return None
+        if level not in ("O1", "O2"):
+            raise ValueError(f"amp level must be O0/O1/O2, got {level!r}")
+        dtype = cfg.get("dtype", "bfloat16")
+        scaler = None
+        # loss scaling matters for f16's narrow exponent range; bf16 matches
+        # f32's range so the scaler is skipped unless explicitly forced
+        want_scaler = (str(dtype) in ("float16", "fp16")
+                       and (cfg.get("use_dynamic_loss_scaling", True)
+                            or "init_loss_scaling" in cfg)) \
+            or cfg.get("force_loss_scaling", False)
+        if want_scaler:
+            scaler = amp_mod.GradScaler(
+                init_loss_scaling=cfg.get("init_loss_scaling", 2.0 ** 15),
+                incr_ratio=cfg.get("incr_ratio", 2.0),
+                decr_ratio=cfg.get("decr_ratio", 0.5),
+                incr_every_n_steps=cfg.get("incr_every_n_steps", 1000),
+                decr_every_n_nan_or_inf=cfg.get("decr_every_n_nan_or_inf", 2),
+                use_dynamic_loss_scaling=cfg.get(
+                    "use_dynamic_loss_scaling", True))
+        if level == "O2" and self._optimizer is not None:
+            amp_mod.decorate(self.network, self._optimizer, level="O2",
+                             dtype=dtype)
+        return {"level": level, "dtype": dtype, "scaler": scaler,
+                "custom_white_list": cfg.get("custom_white_list"),
+                "custom_black_list": cfg.get("custom_black_list")}
 
     def _compute_loss(self, outputs, labels):
         loss = self._loss

@@ -15,6 +15,7 @@ import warnings
 from collections import OrderedDict
 from typing import Callable, Iterator, Optional, Tuple
 
+import jax.numpy as jnp
 import numpy as np
 
 from ...core.tensor import Tensor
@@ -407,7 +408,7 @@ class Layer:
             for p in self.parameters():
                 p._value = p._value.astype(jd)
             for b in self.buffers():
-                if np.issubdtype(b.dtype, np.floating):
+                if jnp.issubdtype(b.dtype, jnp.floating):
                     b._value = b._value.astype(jd)
             self._dtype = jd
         return self

@@ -82,7 +82,13 @@ class Optimizer:
     def _ensure_slots(self, params: Dict[str, jnp.ndarray]):
         for name, v in params.items():
             if name not in self._slots:
-                self._slots[name] = self._init_slots_for(name, v)
+                s = self._init_slots_for(name, v)
+                if self._multi_precision and v.dtype in (jnp.bfloat16,
+                                                         jnp.float16):
+                    # f32 master copy (reference multi_precision path,
+                    # operators/optimizers/adam_op.cu MasterParam)
+                    s["master"] = v.astype(jnp.float32)
+                self._slots[name] = s
 
     # -- the pure update (embeddable in any jitted program) ------------------
     def _rule(self, p, g, slots, lr, t):
@@ -109,14 +115,24 @@ class Optimizer:
                          if param_meta.get(k, {}).get("need_clip", True)}
             clipped = self._grad_clip.apply(clippable)
             reg_grads.update(clipped)
-        # 3) per-param rule
+        # 3) per-param rule (master-weight path: rule runs on the f32 master
+        # slot, the low-precision param is re-derived from it)
         new_params, new_slots = {}, {}
         for k, p in params.items():
             g = reg_grads[k]
             lr_k = lr * param_meta.get(k, {}).get("lr_ratio", 1.0)
-            np_, ns = self._rule(p, g.astype(p.dtype), self._slots_of(slots, k),
-                                 lr_k, t)
-            new_params[k] = np_
+            sl = self._slots_of(slots, k)
+            master = sl.get("master") if isinstance(sl, dict) else None
+            if master is not None:
+                rest = {kk: vv for kk, vv in sl.items() if kk != "master"}
+                new_master, ns = self._rule(master, g.astype(jnp.float32),
+                                            rest, lr_k, t)
+                ns = dict(ns)
+                ns["master"] = new_master
+                new_params[k] = new_master.astype(p.dtype)
+            else:
+                new_params[k], ns = self._rule(p, g.astype(p.dtype), sl,
+                                               lr_k, t)
             new_slots[k] = ns
         return new_params, new_slots
 
@@ -332,7 +348,18 @@ class AdamW(Adam):
                         and not self._apply_decay_param_fun(k)):
                     continue
                 p = params[k]
-                new_params[k] = new_params[k] - (lr * wd).astype(p.dtype) * p
+                sl = new_slots.get(k, {})
+                if "master" in sl:
+                    # decay must land on the f32 master (the param is
+                    # re-derived from it next step — decaying only the bf16
+                    # copy would silently discard the decay every step)
+                    old_master = self._slots_of(slots, k).get(
+                        "master", p.astype(jnp.float32))
+                    sl["master"] = sl["master"] - lr * wd * old_master
+                    new_params[k] = sl["master"].astype(p.dtype)
+                else:
+                    new_params[k] = new_params[k] \
+                        - (lr * wd).astype(p.dtype) * p
         return new_params, new_slots
 
 

@@ -12,6 +12,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..hapi.model import InputSpec  # noqa: F401
+from . import amp  # noqa: F401
 from .executor import (BuildStrategy, CompiledProgram, ExecutionStrategy,  # noqa: F401
                        Executor)
 from .program import (Program, Variable, StaticParam, default_main_program,  # noqa: F401

@@ -1,0 +1,79 @@
+"""Static-graph AMP (reference python/paddle/static/amp ->
+fluid/contrib/mixed_precision/decorator.py).
+
+Design delta: no program rewriting with cast ops. The Program records
+dtype-agnostic kernels; `decorate` tags the Program with an AMP policy and
+the Executor applies per-op input casts (amp.policy_dtype over the same
+white/black lists as eager auto_cast) while lowering the whole program into
+one jitted step — the casts fuse away in XLA.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..amp import GradScaler, black_list, white_list  # noqa: F401
+from .program import default_main_program
+
+__all__ = ["decorate", "CustomOpLists", "AutoMixedPrecisionLists"]
+
+
+class AutoMixedPrecisionLists:
+    """reference fluid/contrib/mixed_precision/fp16_lists.py."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = white_list() | set(custom_white_list or ())
+        self.black_list = (black_list() | set(custom_black_list or ())) \
+            - set(custom_white_list or ())
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+class _AmpOptimizer:
+    """Wraps an optimizer so minimize() tags the program with the policy
+    (reference decorator.py OptimizerWithMixedPrecision)."""
+
+    def __init__(self, optimizer, amp_lists, level, dtype,
+                 use_dynamic_loss_scaling, init_loss_scaling):
+        self._opt = optimizer
+        self._amp_lists = amp_lists
+        self._level = level
+        self._dtype = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") \
+            else jnp.float16
+        # bf16 covers f32's exponent range: loss scaling is a no-op for it.
+        # fp16 static training would need in-program dynamic loss scaling,
+        # which the Executor does not implement yet — refuse rather than
+        # silently train with underflowing grads.
+        if self._dtype == jnp.float16 and use_dynamic_loss_scaling:
+            raise NotImplementedError(
+                "static-graph float16 AMP with dynamic loss scaling is not "
+                "supported; use dtype='bfloat16' (TPU-native, needs no "
+                "scaling) or the dygraph GradScaler path")
+        self._init_loss_scaling = init_loss_scaling
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        program = getattr(loss, "program", None) or default_main_program()
+        program.amp_level = self._level
+        program.amp_dtype = self._dtype
+        if self._amp_lists is not None:
+            program.amp_lists = (frozenset(self._amp_lists.white_list),
+                                 frozenset(self._amp_lists.black_list))
+        return self._opt.minimize(loss, startup_program=startup_program,
+                                  parameters=parameters,
+                                  no_grad_set=no_grad_set)
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+
+def decorate(optimizer, amp_lists=None, level="O1", dtype="bfloat16",
+             init_loss_scaling=2.0 ** 15, use_dynamic_loss_scaling=True,
+             **kwargs):
+    """paddle.static.amp.decorate: returns an optimizer whose minimize()
+    enables AMP for the whole program."""
+    if level not in ("O1", "O2"):
+        raise ValueError(f"amp level must be O1/O2, got {level!r}")
+    return _AmpOptimizer(optimizer, amp_lists, level, dtype,
+                         use_dynamic_loss_scaling, init_loss_scaling)

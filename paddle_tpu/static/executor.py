@@ -149,8 +149,11 @@ class Executor:
     def _compile(self, program: Program, feed_names, fetch_ids,
                  data_parallel):
         import jax.tree_util as jtu
-        ops = [(op.fn, op.flat, op.n_args, op.kw_tree, op.out_ids)
+        ops = [(op.fn, op.flat, op.n_args, op.kw_tree, op.out_ids, op.name)
                for op in program.ops]
+        amp_level = getattr(program, "amp_level", None)
+        amp_dtype = getattr(program, "amp_dtype", jnp.bfloat16)
+        amp_white, amp_black = getattr(program, "amp_lists", (None, None))
         persist = list(program.persist_ids.items())
         persist_names = [n for n, _ in persist]
         data_ids = {n: v.var_id for n, v in program.data_vars.items()}
@@ -167,8 +170,12 @@ class Executor:
                 for p, _ in opt_sec[1]}
 
         def run_ops(env):
-            for fn, flat, n_args, kw_tree, out_ids in ops:
+            for fn, flat, n_args, kw_tree, out_ids, opname in ops:
                 vals = [_resolve(x, env) for x in flat]
+                if amp_level:  # program-level AMP (paddle_tpu.static.amp)
+                    from .. import amp as amp_mod
+                    vals = amp_mod.cast_vals(opname, vals, amp_level,
+                                             amp_dtype, amp_white, amp_black)
                 kw = jtu.tree_unflatten(kw_tree, vals[n_args:])
                 out = fn(*vals[:n_args], **kw)
                 if len(out_ids) == 1 and not isinstance(out, (tuple, list)):

@@ -1,0 +1,270 @@
+"""AMP tests.
+
+Models the reference's amp op tests (test_amp_check_finite_and_scale_op.py,
+test_update_loss_scaling_op.py) and API tests (test_amp_api / hapi amp)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn, optimizer
+
+
+def test_autocast_white_op_bf16():
+    x = paddle.ones([4, 8])
+    w = paddle.ones([8, 4])
+    with amp.auto_cast(level="O1"):
+        y = paddle.ops.matmul(x, w)
+    assert y._value.dtype == jnp.bfloat16
+
+
+def test_autocast_black_op_f32():
+    x = paddle.ones([4, 8]).astype("bfloat16")
+    with amp.auto_cast(level="O1"):
+        y = paddle.ops.softmax(x)
+    assert y._value.dtype == jnp.float32
+
+
+def test_autocast_gray_op_keeps_dtype():
+    x = paddle.ones([4])
+    with amp.auto_cast(level="O1"):
+        y = x + x
+    assert y._value.dtype == jnp.float32
+
+
+def test_autocast_o2_casts_gray():
+    x = paddle.ones([4])
+    with amp.auto_cast(level="O2"):
+        y = x + x
+    assert y._value.dtype == jnp.bfloat16
+
+
+def test_autocast_off_outside_context():
+    x = paddle.ones([4, 8])
+    w = paddle.ones([8, 4])
+    y = paddle.ops.matmul(x, w)
+    assert y._value.dtype == jnp.float32
+
+
+def test_autocast_custom_lists():
+    x = paddle.ones([4, 8])
+    w = paddle.ones([8, 4])
+    with amp.auto_cast(level="O1", custom_black_list={"matmul"}):
+        y = paddle.ops.matmul(x, w)
+    assert y._value.dtype == jnp.float32
+
+
+def test_autocast_grad_dtype_matches_param():
+    # the cast sits inside the differentiated region: f32 leaves get f32
+    # grads even when compute ran in bf16
+    w = paddle.ones([8, 4])
+    w.stop_gradient = False
+    x = paddle.ones([2, 8])
+    with amp.auto_cast(level="O1"):
+        y = paddle.ops.matmul(x, w)
+    y.sum().backward()
+    assert w.grad._value.dtype == jnp.float32
+
+
+def test_check_finite_and_unscale():
+    grads = {"a": jnp.asarray([2.0, 4.0]), "b": jnp.asarray([8.0])}
+    out, found = amp.check_finite_and_unscale(grads, jnp.asarray(2.0))
+    assert not bool(found)
+    np.testing.assert_allclose(np.asarray(out["a"]), [1.0, 2.0])
+    grads["b"] = jnp.asarray([jnp.inf])
+    out, found = amp.check_finite_and_unscale(grads, jnp.asarray(2.0))
+    assert bool(found)
+
+
+def test_update_loss_scaling_dynamics():
+    s = jnp.asarray(1024.0, jnp.float32)
+    good = jnp.asarray(0, jnp.int32)
+    bad = jnp.asarray(0, jnp.int32)
+    # two consecutive nan steps at decr_every_n_nan_or_inf=2 halve the scale
+    s1, good, bad = amp.update_loss_scaling(
+        s, good, bad, jnp.asarray(True), incr_ratio=2.0, decr_ratio=0.5,
+        incr_every_n_steps=3, decr_every_n_nan_or_inf=2)
+    assert float(s1) == 1024.0 and int(bad) == 1
+    s2, good, bad = amp.update_loss_scaling(
+        s1, good, bad, jnp.asarray(True), incr_ratio=2.0, decr_ratio=0.5,
+        incr_every_n_steps=3, decr_every_n_nan_or_inf=2)
+    assert float(s2) == 512.0 and int(bad) == 0
+    # three good steps double it
+    for _ in range(3):
+        s2, good, bad = amp.update_loss_scaling(
+            s2, good, bad, jnp.asarray(False), incr_ratio=2.0,
+            decr_ratio=0.5, incr_every_n_steps=3, decr_every_n_nan_or_inf=2)
+    assert float(s2) == 1024.0
+
+
+def test_grad_scaler_eager_skip_on_inf():
+    lin = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=4.0,
+                            use_dynamic_loss_scaling=True,
+                            decr_every_n_nan_or_inf=1)
+    w_before = np.asarray(lin.weight._value).copy()
+    x = paddle.to_tensor(np.full((2, 4), np.inf, np.float32))
+    loss = scaler.scale(lin(x).sum())
+    loss.backward()
+    scaler.step(opt)   # found_inf -> update skipped
+    scaler.update()    # scale halves
+    np.testing.assert_array_equal(np.asarray(lin.weight._value), w_before)
+    assert scaler.get_loss_scaling() == 2.0
+    opt.clear_grad()
+    # finite step updates params and resets
+    x = paddle.ones([2, 4])
+    loss = scaler.scale(lin(x).sum())
+    loss.backward()
+    scaler.step(opt)
+    scaler.update()
+    assert not np.array_equal(np.asarray(lin.weight._value), w_before)
+
+
+def test_master_weight_optimizer():
+    # bf16 param + multi_precision: master slot carries f32 precision, so
+    # many tiny updates that vanish in bf16 accumulate correctly
+    w = paddle.ones([64]).astype("bfloat16")
+    w.stop_gradient = False
+    w.name = "w"
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[w],
+                        multi_precision=True)
+    params = {"w": w._value}
+    opt._ensure_slots(params)
+    assert opt._slots["w"]["master"].dtype == jnp.float32
+    slots = dict(opt._slots)
+    g = jnp.full((64,), 1e-4, jnp.bfloat16)  # 1 - 1e-4 rounds to 1 in bf16
+    p, s = params, slots
+    for t in range(100):
+        p, s = opt.apply_gradients_pure(
+            p, {"w": g}, s, jnp.asarray(1.0), jnp.asarray(t + 1))
+    master = np.asarray(s["w"]["master"])
+    np.testing.assert_allclose(master, 1.0 - 1e-2, rtol=1e-3)
+    # without master weights the bf16 param would still be exactly 1.0;
+    # the cast-back is only bf16-accurate (eps ~ 0.004 at 1.0)
+    assert abs(float(np.asarray(p["w"])[0]) - (1.0 - 1e-2)) < 4e-3
+
+
+def test_hapi_fit_with_amp_o2():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    model = Model(net, inputs=[InputSpec([None, 8], "float32", "x")],
+                  labels=[InputSpec([None], "int64", "y")])
+    opt = optimizer.AdamW(learning_rate=0.01, parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(),
+                  amp_configs={"level": "O2", "dtype": "bfloat16"})
+    # O2 decorate: params cast to bf16, optimizer has master weights
+    assert net[0].weight._value.dtype == jnp.bfloat16
+    assert opt._multi_precision
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 8).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    import paddle_tpu.io as io
+    ds = [(x[i], y[i]) for i in range(64)]
+    losses = []
+    for ep in range(4):
+        out = model.fit(ds, batch_size=16, epochs=1, verbose=0)
+        l0 = model.evaluate(ds, batch_size=32, verbose=0)["loss"]
+        losses.append(l0)
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+    # master slots exist for every trainable param
+    for k, s in opt._slots.items():
+        assert "master" in s and s["master"].dtype == jnp.float32
+
+
+def test_fp16_amp_with_scaler_in_fit():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = Model(net, inputs=[InputSpec([None, 8], "float32", "x")],
+                  labels=[InputSpec([None], "int64", "y")])
+    opt = optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(),
+                  amp_configs={"level": "O1", "dtype": "float16",
+                               "init_loss_scaling": 128.0})
+    scaler = model._amp_configs["scaler"]
+    assert scaler is not None
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = (x[:, 1] > 0).astype(np.int64)
+    ds = [(x[i], y[i]) for i in range(32)]
+    model.fit(ds, batch_size=8, epochs=2, verbose=0)
+    assert scaler.get_loss_scaling() > 0
+
+
+def test_static_amp_program_level():
+    import paddle_tpu.static as static
+
+    paddle.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            w = static.nn.fc(x, 16)
+            loss = paddle.ops.mean(w)
+            opt = optimizer.SGD(learning_rate=0.1)
+            opt = static.amp.decorate(opt, level="O1")
+            opt.minimize(loss)
+        assert prog.amp_level == "O1"
+        exe = static.Executor()
+        rng = np.random.RandomState(0)
+        out1 = exe.run(prog, feed={"x": rng.randn(4, 8).astype(np.float32)},
+                       fetch_list=[loss])
+        out2 = exe.run(prog, feed={"x": rng.randn(4, 8).astype(np.float32)},
+                       fetch_list=[loss])
+        assert np.isfinite(out1[0]).all() and np.isfinite(out2[0]).all()
+    finally:
+        paddle.disable_static()
+
+
+def test_adamw_master_weight_decay_accumulates():
+    # decoupled decay must land on the f32 master, not only the bf16 copy
+    w = (paddle.ones([32]) * 2.0).astype("bfloat16")
+    w.stop_gradient = False
+    w.name = "w"
+    opt = optimizer.AdamW(learning_rate=0.1, weight_decay=0.1,
+                          parameters=[w], multi_precision=True)
+    params = {"w": w._value}
+    opt._ensure_slots(params)
+    s = dict(opt._slots)
+    p = params
+    zero_g = {"w": jnp.zeros([32], jnp.bfloat16)}
+    masters = []
+    for t in range(3):
+        p, s = opt.apply_gradients_pure(p, zero_g, s, jnp.asarray(0.1),
+                                        jnp.asarray(t + 1))
+        masters.append(float(np.asarray(s["w"]["master"])[0]))
+    # with zero grads, each step multiplies the master by (1 - lr*wd)=0.99
+    np.testing.assert_allclose(masters, [2 * 0.99, 2 * 0.99 ** 2,
+                                         2 * 0.99 ** 3], rtol=1e-5)
+
+
+def test_fp16_scaler_with_grad_accumulation():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = Model(net, inputs=[InputSpec([None, 8], "float32", "x")],
+                  labels=[InputSpec([None], "int64", "y")])
+    opt = optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(),
+                  amp_configs={"level": "O1", "dtype": "float16",
+                               "init_loss_scaling": 64.0})
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = (x[:, 1] > 0).astype(np.int64)
+    ds = [(x[i], y[i]) for i in range(32)]
+    l0 = model.evaluate(ds, batch_size=32, verbose=0)["loss"]
+    model.fit(ds, batch_size=8, epochs=3, verbose=0,
+              accumulate_grad_batches=2)
+    l1 = model.evaluate(ds, batch_size=32, verbose=0)["loss"]
+    assert l1 < l0, f"accumulated fp16 training did not learn: {l0} -> {l1}"
