@@ -72,7 +72,12 @@ def init(role_maker=None, is_collective=True, strategy=None):
     for v in shape.values():
         total *= v
     if total != n:
-        shape = {"dp": n}  # fall back to pure DP if degrees don't factor
+        raise ValueError(
+            f"hybrid parallel degrees {dict(degrees)} imply mesh {shape} "
+            f"({total} devices) but {n} devices are available; degrees must "
+            f"factor the device count exactly (the reference likewise "
+            f"rejects bad strategy configs rather than silently rewriting "
+            f"the user's parallelism)")
     mesh_mod.init_mesh(shape)
     _fleet_state["hcg"] = HybridCommunicateGroup(shape)
     return _FleetFacade()

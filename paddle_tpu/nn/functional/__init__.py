@@ -89,10 +89,27 @@ def unfold_linear(*a, **k):  # placeholder parity helper
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64"):
-    from ...ops._dispatch import unwrap, wrap
+    """Mask [..., maxlen] with 1 where position < length.
+
+    The mask width is a *shape*, so it must be static under jit: a traced
+    `maxlen` (or `maxlen=None` with traced lengths) raises a clear error
+    instead of an opaque ConcretizationTypeError mid-trace.
+    """
+    import jax
     import jax.numpy as jnp
+    from ...ops._dispatch import unwrap, wrap
     lv = unwrap(lengths)
-    m = int(maxlen) if maxlen is not None else int(lv.max())
+    m = unwrap(maxlen) if maxlen is not None else None
+    if m is None:
+        m = lv.max() if hasattr(lv, "max") else max(lv)
+    if isinstance(m, jax.core.Tracer):
+        raise ValueError(
+            "sequence_mask needs a concrete mask width, but "
+            + ("maxlen is a traced value" if maxlen is not None
+               else "maxlen=None and `lengths` is traced")
+            + "; under jit the output shape must be static — pass a "
+              "Python-int maxlen")
+    m = int(m)
     mask = jnp.arange(m)[None, :] < lv[..., None]
     from ...core.dtype import to_jax_dtype
     return wrap(mask.astype(to_jax_dtype(dtype)))

@@ -1,0 +1,40 @@
+"""Regression tests for round-3 fixes (VERDICT r02 "what's weak")."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed import mesh as mesh_mod
+
+
+def test_fleet_init_rejects_non_factoring_degrees():
+    """VERDICT weak #6: silent DP fallback was a correctness trap."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 3}  # 3 !| 8
+    with pytest.raises(ValueError, match="factor the device count"):
+        fleet.init(is_collective=True, strategy=strategy)
+    mesh_mod.init_mesh({"dp": 8})
+
+
+def test_sequence_mask_eager_and_jit():
+    lengths = paddle.to_tensor(np.array([1, 3, 2]))
+    m = F.sequence_mask(lengths)  # eager: maxlen inferred
+    assert tuple(m.shape) == (3, 3)
+    assert np.asarray(m._value).tolist() == [[1, 0, 0], [1, 1, 1], [1, 1, 0]]
+
+    import jax
+    import jax.numpy as jnp
+
+    def f(lv):
+        return F.sequence_mask(paddle.Tensor(lv, _internal=True),
+                               maxlen=4)._value
+
+    out = jax.jit(f)(jnp.asarray([2, 4]))  # static maxlen under jit works
+    assert np.asarray(out).tolist() == [[1, 1, 0, 0], [1, 1, 1, 1]]
+
+    def g(lv):
+        return F.sequence_mask(paddle.Tensor(lv, _internal=True))._value
+
+    with pytest.raises(ValueError, match="concrete mask width"):
+        jax.jit(g)(jnp.asarray([2, 4]))  # dynamic width: loud error
