@@ -67,3 +67,9 @@ define_flag("FLAGS_seed", 0, "global random seed")
 define_flag("FLAGS_log_level", 0, "verbose log level (glog VLOG equivalent)")
 define_flag("FLAGS_allocator_strategy", "xla", "kept for parity; XLA owns device memory")
 define_flag("FLAGS_enable_profiler", False, "enable host event profiler")
+define_flag("FLAGS_use_flash_attention", True,
+            "route attention through the Pallas flash kernel on TPU "
+            "(paddle_tpu.ops.pallas.flash_attention)")
+define_flag("FLAGS_flash_attention_interpret", False,
+            "also use the flash kernel off-TPU via the Pallas interpreter "
+            "(slow; for tests)")

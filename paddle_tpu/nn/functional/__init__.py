@@ -70,15 +70,53 @@ def _sdpa(q, k, v, mask, scale, is_causal):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+@defop
+def _flash_sdpa(q, k, v, mask, scale, is_causal):
+    from ...ops.pallas import flash_attention
+    bias = None
+    if mask is not None:
+        # [b,1,1,sk] (bool or additive float) -> additive [b, sk]
+        m = mask.reshape(mask.shape[0], mask.shape[-1])
+        if m.dtype == jnp.bool_:
+            bias = jnp.where(m, 0.0, -1e9).astype(jnp.float32)
+        else:
+            bias = m.astype(jnp.float32)
+    return flash_attention(q, k, v, bias=bias, causal=is_causal, scale=scale)
+
+
+def _flash_eligible(query, key, value, attn_mask):
+    from ...core import flags as _flags
+    if not _flags.flag("FLAGS_use_flash_attention"):
+        return False
+    import jax
+    if jax.default_backend() != "tpu" \
+            and not _flags.flag("FLAGS_flash_attention_interpret"):
+        return False
+    if attn_mask is not None and isinstance(attn_mask, Tensor) \
+            and not attn_mask.stop_gradient:
+        # the kernel treats the bias as data (no mask gradient); a learned
+        # additive mask must take the jnp path, which differentiates it
+        return False
+    from ...ops.pallas.flash_attention import supported
+    mask_shape = None if attn_mask is None else tuple(attn_mask.shape)
+    return supported(tuple(query.shape), tuple(key.shape),
+                     tuple(value.shape), mask_shape)
+
+
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, scale=None,
                                  training=True):
-    """Fused attention core. On TPU the Pallas flash-attention kernel
-    (paddle_tpu.ops.pallas) replaces this for long sequences; this reference
-    path lets XLA fuse the softmax chain."""
+    """Fused attention core. On TPU this routes through the Pallas
+    flash-attention kernel (paddle_tpu.ops.pallas.flash_attention): O(s)
+    attention memory, blockwise online softmax on the MXU. The jnp fallback
+    (_sdpa) covers general mask shapes and non-TPU backends, where XLA
+    fuses the softmax chain."""
     head_dim = query.shape[-1] if not isinstance(query, Tensor) else query.shape[-1]
     sc = scale if scale is not None else head_dim ** -0.5
-    out = _sdpa(query, key, value, attn_mask, sc, is_causal)
+    if _flash_eligible(query, key, value, attn_mask):
+        out = _flash_sdpa(query, key, value, attn_mask, sc, is_causal)
+    else:
+        out = _sdpa(query, key, value, attn_mask, sc, is_causal)
     if dropout_p > 0.0 and training:
         out = dropout(out, p=dropout_p, training=True)
     return out

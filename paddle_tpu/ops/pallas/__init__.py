@@ -1,0 +1,17 @@
+"""Hand-written Pallas TPU kernels.
+
+TPU-native analog of the reference's hand-written kernel tiers —
+operators/math/ (~30k LoC of CPU/CUDA primitives) and operators/jit/
+(runtime x86 codegen, reference jit/gen/jitcode.h:23). Where the reference
+drops to CUDA/xbyak for the ops XLA-era compilers couldn't fuse, we drop to
+Pallas for the ops XLA *still* can't schedule optimally: flash attention
+(O(s) memory online-softmax attention) is the first; kernels here own their
+backward passes via jax.custom_vjp (the analog of hand-written *_grad
+kernels).
+
+Kernels run compiled on TPU and in Pallas interpreter mode elsewhere, so the
+same code paths are testable on the CPU mesh (tests/conftest.py).
+"""
+from .flash_attention import flash_attention  # noqa: F401
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,404 @@
+"""Flash attention — online-softmax attention as a Pallas TPU kernel.
+
+TPU-native replacement for the unfused softmax(QK^T)V chain: the reference
+hand-writes its attention-adjacent kernels in CUDA/xbyak
+(reference operators/math/softmax.cu, operators/jit/gen/jitcode.h:23,
+operators/fused/multihead_matmul_op.cu); on TPU the equivalent tier is
+Pallas. The kernel never materializes the [s_q, s_k] score matrix in HBM —
+scores live blockwise in VMEM with f32 running max/sum accumulators, so
+attention memory is O(s) and both matmuls hit the MXU in bf16 with f32
+accumulation.
+
+Forward and backward are separate kernels wired through jax.custom_vjp (the
+analog of the reference's hand-written *_grad kernels): backward recomputes
+scores blockwise from the saved logsumexp, FlashAttention-2 style.
+
+Layout: q, k, v are [batch*heads, seq, head_dim]; an optional additive bias
+[batch, s_k] implements padding masks; `causal=True` adds the triangular
+mask in-kernel. Runs compiled on TPU, interpreted elsewhere (CPU mesh
+tests).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e9  # finite "masked" value: keeps running-max finite even for
+                # fully-padded rows (exp(NEG_INF - NEG_INF) stays sane)
+
+
+def _pick_block(s: int, target: int = 128):
+    """Largest block size <= target that divides s, no smaller than 8 (the
+    f32 sublane tile); None means "not kernel-friendly, use the jnp path"."""
+    for b in (target, 128, 64, 32, 16, 8):
+        if b <= target and s % b == 0:
+            return b
+    return None
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+def _causal_live(iq, ik, bq, bk, off):
+    """Is block (iq, ik) at least partly unmasked under bottom-right-aligned
+    causal masking (col <= row + off, off = s_k - s_q, matching _sdpa)?"""
+    return ik * bk <= iq * bq + (bq - 1) + off
+
+
+def _causal_mask(s, iq, ik, bq, bk, off):
+    row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(row + off >= col, s, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, nk, off):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def _compute():
+        q = q_ref[0]                               # [bq, d]
+        k = k_ref[0]                               # [bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[:]                    # [1, bk] broadcasts
+        if causal:
+            s = _causal_mask(s, iq, ik, bq, bk, off)
+
+        m_prev = m_scr[:]                          # [bq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
+        p = jnp.exp(s - m_new)                     # [bq, bk] f32
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+
+    if causal:
+        pl.when(_causal_live(iq, ik, bq, bk, off))(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _flush():
+        denom = jnp.maximum(l_scr[:], 1e-30)       # fully-masked rows -> 0
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        lse_ref[:] = (m_scr[:] + jnp.log(denom)).reshape(lse_ref.shape)
+
+
+def _fwd(q, k, v, bias, scale, causal, heads, bq, bk):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // bq, sk // bk
+    grid = (bh, nq, nk)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda ib, iq, ik: (ib, iq, 0)),
+        pl.BlockSpec((1, bk, d), lambda ib, iq, ik: (ib, ik, 0)),
+        pl.BlockSpec((1, bk, d), lambda ib, iq, ik: (ib, ik, 0)),
+    ]
+    args = [q, k, v]
+    if bias is not None:
+        in_specs.append(
+            pl.BlockSpec((1, bk), lambda ib, iq, ik: (ib // heads, ik)))
+        args.append(bias)
+
+    opts = dict(scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
+                off=sk - sq)
+    if bias is not None:
+        kernel = functools.partial(_fwd_kernel, **opts)
+    else:
+        def kernel(qr, kr, vr, o, lse, m, l, a):  # noqa: E741
+            return _fwd_kernel(qr, kr, vr, None, o, lse, m, l, a, **opts)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda ib, iq, ik: (ib, iq, 0)),
+            pl.BlockSpec((1, bq), lambda ib, iq, ik: (ib, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((bq, 1), jnp.float32),
+            _vmem((bq, 1), jnp.float32),
+            _vmem((bq, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return out, lse
+
+
+def _vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, dtype)
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, scale, causal, bq, bk, nk, off):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[:]
+        if causal:
+            s = _causal_mask(s, iq, ik, bq, bk, off)
+
+        lse = lse_ref[:].reshape(bq, 1)
+        p = jnp.exp(s - lse)                        # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[:].reshape(bq, 1)
+        ds = p * (dp - delta) * scale               # [bq, bk] f32
+        dq_scr[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                         (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_causal_live(iq, ik, bq, bk, off))(_compute)
+    else:
+        _compute()
+
+    @pl.when(ik == nk - 1)
+    def _flush():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, bq, bk, nq, off):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            s = s + bias_ref[:]
+        if causal:
+            s = _causal_mask(s, iq, ik, bq, bk, off)
+
+        lse = lse_ref[:].reshape(bq, 1)
+        p = jnp.exp(s - lse)                        # [bq, bk] f32
+        # dv += P^T dO   (contract over bq)
+        dv_scr[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[:].reshape(bq, 1)
+        ds = p * (dp - delta) * scale
+        # dk += dS^T Q   (contract over bq)
+        dk_scr[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(_causal_live(iq, ik, bq, bk, off))(_compute)
+    else:
+        _compute()
+
+    @pl.when(iq == nq - 1)
+    def _flush():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, bias, out, lse, do, scale, causal, heads, bq, bk):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // bq, sk // bk
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                        # [bh, sq]
+
+    def specs(extra_bias):
+        base = [
+            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, i, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, j, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, j, 0)),   # v
+        ]
+        if extra_bias:
+            base.append(
+                pl.BlockSpec((1, bk), lambda ib, i, j: (ib // heads, j)))
+        base += [
+            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, i, 0)),   # do
+            pl.BlockSpec((1, bq), lambda ib, i, j: (ib, i)),         # lse
+            pl.BlockSpec((1, bq), lambda ib, i, j: (ib, i)),         # delta
+        ]
+        return base
+
+    args = ([q, k, v, bias] if bias is not None else [q, k, v]) \
+        + [do, lse, delta]
+
+    # ---- dq: grid (bh, nq, nk), k-blocks innermost -----------------------
+    dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                                  bq=bq, bk=bk, nk=nk, off=sk - sq)
+    if bias is None:
+        inner_dq = dq_kernel
+
+        def dq_kernel(qr, kr, vr, dor, lser, dr, dqr, scr):  # noqa: F811
+            return inner_dq(qr, kr, vr, None, dor, lser, dr, dqr, scr)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=specs(bias is not None),
+        out_specs=pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[_vmem((bq, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*args)
+
+    # ---- dk/dv: grid (bh, nk, nq), q-blocks innermost --------------------
+    def specs_kv(extra_bias):
+        base = [
+            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, j, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, 0)),   # k
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, 0)),   # v
+        ]
+        if extra_bias:
+            base.append(
+                pl.BlockSpec((1, bk), lambda ib, i, j: (ib // heads, i)))
+        base += [
+            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, j, 0)),   # do
+            pl.BlockSpec((1, bq), lambda ib, i, j: (ib, j)),         # lse
+            pl.BlockSpec((1, bq), lambda ib, i, j: (ib, j)),         # delta
+        ]
+        return base
+
+    dkv_kernel = functools.partial(_bwd_dkv_kernel, scale=scale,
+                                   causal=causal, bq=bq, bk=bk, nq=nq,
+                                   off=sk - sq)
+    if bias is None:
+        inner_dkv = dkv_kernel
+
+        def dkv_kernel(qr, kr, vr, dor, lser, dr, dkr, dvr, ks, vs):  # noqa: F811,E501
+            return inner_dkv(qr, kr, vr, None, dor, lser, dr, dkr, dvr,
+                             ks, vs)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, nk, nq),
+        in_specs=specs_kv(bias is not None),
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[_vmem((bk, d), jnp.float32),
+                        _vmem((bk, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*args)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# public op (custom_vjp)
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, bias, scale, causal, heads, bq, bk):
+    out, _ = _fwd(q, k, v, bias, scale, causal, heads, bq, bk)
+    return out
+
+
+def _flash_fwd(q, k, v, bias, scale, causal, heads, bq, bk):
+    out, lse = _fwd(q, k, v, bias, scale, causal, heads, bq, bk)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_bwd(scale, causal, heads, bq, bk, res, g):
+    q, k, v, bias, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, bias, out, lse, g, scale, causal, heads,
+                      bq, bk)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def supported(q_shape, k_shape, v_shape, mask_shape=None) -> bool:
+    """Static predicate: can flash_attention handle these shapes? Anything
+    rejected here must take the jnp fallback (_sdpa), which handles general
+    broadcasting."""
+    if len(q_shape) != 4 or len(k_shape) != 4 or len(v_shape) != 4:
+        return False
+    b, h, sq, d = q_shape
+    sk = k_shape[2]
+    if d > 256 or k_shape[3] != d or v_shape[3] != d or v_shape[2] != sk:
+        return False
+    if _pick_block(sq) is None or _pick_block(sk) is None:
+        return False
+    if mask_shape is not None:
+        # exactly [b, 1, 1, sk]: the kernel's bias path does no broadcasting
+        if tuple(mask_shape) != (b, 1, 1, sk):
+            return False
+    return True
+
+
+def flash_attention(q, k, v, bias=None, causal=False, scale=None):
+    """Online-softmax attention, O(s) memory.
+
+    q: [b, h, s_q, d]; k, v: [b, h, s_k, d]; bias: optional additive mask
+    [b, s_k] (f32; use NEG_INF-scale values for masked keys — treated as
+    non-differentiable data). Returns [b, h, s_q, d] in q's dtype.
+    """
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if k.shape[3] != d or v.shape[3] != d or v.shape[2] != sk:
+        raise ValueError(
+            f"flash_attention needs matching head_dim/seq for k and v; got "
+            f"q{tuple(q.shape)} k{tuple(k.shape)} v{tuple(v.shape)}")
+    if scale is None:
+        scale = d ** -0.5
+    bq, bk = _pick_block(sq), _pick_block(sk)
+    if bq is None or bk is None:
+        raise ValueError(f"flash_attention: seq lengths ({sq},{sk}) have no "
+                         "power-of-two block factor; pad to a multiple of 8")
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    if bias is not None:
+        bias = jax.lax.stop_gradient(bias.astype(jnp.float32))
+    out = _flash(qf, kf, vf, bias, float(scale), bool(causal), h, bq, bk)
+    return out.reshape(b, h, sq, d)

@@ -1,0 +1,150 @@
+"""Pallas flash-attention kernel: numeric parity with the jnp path.
+
+Runs the kernel in Pallas interpreter mode on the CPU mesh (same code path
+as compiled TPU modulo Mosaic lowering), mirroring the reference's
+golden-op discipline (reference unittests/op_test.py:232 — kernel output
+vs numpy reference, analytic grads vs finite differences elsewhere in
+tests/op_test.py).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops.pallas import flash_attention
+
+
+def _ref(q, k, v, bias=None, causal=False, scale=None):
+    d = q.shape[-1]
+    sc = scale or d ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sc
+    if bias is not None:
+        s = s + bias[:, None, None, :]
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones(s.shape[-2:], bool)), s, -1e9)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("b,h,sq,sk,d,causal,with_bias", [
+    (2, 3, 32, 32, 16, False, False),
+    (1, 2, 64, 64, 32, True, False),
+    (2, 2, 32, 64, 8, False, True),
+])
+def test_flash_matches_reference(b, h, sq, sk, d, causal, with_bias):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, h, sq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, sk, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, sk, d), jnp.float32)
+    bias = jnp.asarray(np.where(rng.rand(b, sk) < 0.3, -1e9, 0.0),
+                       jnp.float32) if with_bias else None
+
+    out = flash_attention(q, k, v, bias=bias, causal=causal)
+    ref = _ref(q, k, v, bias, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    g1 = jax.grad(lambda *a: flash_attention(
+        *a, bias=bias, causal=causal).sum(), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: _ref(*a, bias, causal).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=5e-5)
+
+
+def test_sdpa_routes_through_flash():
+    """The functional API picks the kernel when the flag forces interpret
+    mode, and its output matches the jnp path — through the autograd tape."""
+    paddle.set_flags({"FLAGS_flash_attention_interpret": True})
+    try:
+        rng = np.random.RandomState(1)
+        mk = lambda *s: paddle.to_tensor(  # noqa: E731
+            rng.randn(*s).astype("float32"), stop_gradient=False)
+        q, k, v = mk(2, 2, 32, 16), mk(2, 2, 32, 16), mk(2, 2, 32, 16)
+        out_flash = F.scaled_dot_product_attention(q, k, v)
+        paddle.set_flags({"FLAGS_flash_attention_interpret": False})
+        out_ref = F.scaled_dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out_flash._value),
+                                   np.asarray(out_ref._value), atol=2e-5)
+
+        paddle.set_flags({"FLAGS_flash_attention_interpret": True})
+        out_flash.sum().backward()
+        gq = np.asarray(q.grad._value)
+        assert np.isfinite(gq).all() and np.abs(gq).max() > 0
+    finally:
+        paddle.set_flags({"FLAGS_flash_attention_interpret": False})
+
+
+def test_mha_layer_uses_flash_and_trains():
+    """MultiHeadAttention forward/backward through the kernel, bf16-safe."""
+    from paddle_tpu import nn
+    paddle.set_flags({"FLAGS_flash_attention_interpret": True})
+    try:
+        paddle.seed(0)
+        mha = nn.MultiHeadAttention(32, 2, dropout=0.0)
+        x = paddle.randn([2, 16, 32])
+        x.stop_gradient = False
+        out = mha(x)
+        assert tuple(out.shape) == (2, 16, 32)
+        out.mean().backward()
+        assert mha.qkv_proj.weight.grad is not None
+        g = np.asarray(mha.qkv_proj.weight.grad._value)
+        assert np.isfinite(g).all()
+    finally:
+        paddle.set_flags({"FLAGS_flash_attention_interpret": False})
+
+
+def test_flash_causal_rectangular_matches_sdpa():
+    """Bottom-right-aligned causal mask for sq != sk (KV-cache decode):
+    the kernel must agree with the jnp path's tril(k=sk-sq) convention."""
+    from paddle_tpu.nn.functional import _sdpa
+    rng = np.random.RandomState(3)
+    for sq, sk in [(8, 64), (32, 64)]:
+        q = jnp.asarray(rng.randn(1, 2, sq, 16), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 2, sk, 16), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 2, sk, 16), jnp.float32)
+        out = flash_attention(q, k, v, causal=True)
+        ref = _sdpa.raw(q, k, v, None, 16 ** -0.5, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+
+def test_flash_fallbacks():
+    """Shapes the kernel can't handle must route to the jnp path, not crash."""
+    from paddle_tpu.ops.pallas.flash_attention import supported
+    # broadcastable-but-not-exact mask shapes
+    assert not supported((2, 2, 32, 16), (2, 2, 32, 16), (2, 2, 32, 16),
+                         (1, 1, 1, 32))
+    assert not supported((2, 2, 32, 16), (2, 2, 32, 16), (2, 2, 32, 16),
+                         (2, 1, 1, 1))
+    # v head_dim differs from q/k
+    assert not supported((1, 2, 32, 16), (1, 2, 32, 16), (1, 2, 32, 32))
+    # odd sequence length: no block factor
+    assert not supported((1, 2, 33, 16), (1, 2, 33, 16), (1, 2, 33, 16))
+    # the functional API still works on those shapes (fallback path)
+    paddle.set_flags({"FLAGS_flash_attention_interpret": True})
+    try:
+        rng = np.random.RandomState(4)
+        mk = lambda *s: paddle.to_tensor(  # noqa: E731
+            rng.randn(*s).astype("float32"))
+        out = F.scaled_dot_product_attention(mk(1, 2, 33, 16),
+                                             mk(1, 2, 33, 16),
+                                             mk(1, 2, 33, 16))
+        assert tuple(out.shape) == (1, 2, 33, 16)
+    finally:
+        paddle.set_flags({"FLAGS_flash_attention_interpret": False})
+
+
+def test_flash_bf16():
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(1, 2, 64, 32), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(1, 2, 64, 32), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(1, 2, 64, 32), jnp.bfloat16)
+    out = flash_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = _ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=3e-2)
