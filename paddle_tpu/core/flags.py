@@ -73,3 +73,9 @@ define_flag("FLAGS_use_flash_attention", True,
 define_flag("FLAGS_flash_attention_interpret", False,
             "also use the flash kernel off-TPU via the Pallas interpreter "
             "(slow; for tests)")
+define_flag("FLAGS_use_fused_ce", True,
+            "route linear+cross-entropy loss heads through the Pallas "
+            "fused kernel on TPU (paddle_tpu.ops.pallas.fused_ce)")
+define_flag("FLAGS_pallas_interpret", False,
+            "run all Pallas kernels off-TPU via the interpreter (slow; "
+            "for tests)")

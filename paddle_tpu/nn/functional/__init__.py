@@ -7,6 +7,7 @@ attention) are defined here.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ... import ops
@@ -84,13 +85,23 @@ def _flash_sdpa(q, k, v, mask, scale, is_causal):
     return flash_attention(q, k, v, bias=bias, causal=is_causal, scale=scale)
 
 
+def _pallas_backend_ok(extra_flag=None):
+    """Pallas kernels run compiled on TPU; elsewhere only when an interpret
+    flag opts in (tests)."""
+    import jax
+    from ...core import flags as _flags
+    if jax.default_backend() == "tpu":
+        return True
+    if _flags.flag("FLAGS_pallas_interpret"):
+        return True
+    return extra_flag is not None and _flags.flag(extra_flag)
+
+
 def _flash_eligible(query, key, value, attn_mask):
     from ...core import flags as _flags
     if not _flags.flag("FLAGS_use_flash_attention"):
         return False
-    import jax
-    if jax.default_backend() != "tpu" \
-            and not _flags.flag("FLAGS_flash_attention_interpret"):
+    if not _pallas_backend_ok("FLAGS_flash_attention_interpret"):
         return False
     if attn_mask is not None and isinstance(attn_mask, Tensor) \
             and not attn_mask.stop_gradient:
@@ -111,8 +122,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     attention memory, blockwise online softmax on the MXU. The jnp fallback
     (_sdpa) covers general mask shapes and non-TPU backends, where XLA
     fuses the softmax chain."""
-    head_dim = query.shape[-1] if not isinstance(query, Tensor) else query.shape[-1]
-    sc = scale if scale is not None else head_dim ** -0.5
+    sc = scale if scale is not None else query.shape[-1] ** -0.5
     if _flash_eligible(query, key, value, attn_mask):
         out = _flash_sdpa(query, key, value, attn_mask, sc, is_causal)
     else:
@@ -124,6 +134,51 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 def unfold_linear(*a, **k):  # placeholder parity helper
     raise NotImplementedError
+
+
+@defop
+def _fused_ce_op(hidden, weight, bias, labels, ignore_index):
+    from ...ops.pallas.fused_ce import fused_linear_cross_entropy as _k
+    return _k(hidden, weight, bias, labels, ignore_index=ignore_index)
+
+
+@defop
+def _ce_head_fallback(hidden, weight, bias, labels, ignore_index):
+    # same contract as the kernel: f32 per-token losses, 0 where ignored
+    logits = jnp.dot(hidden, weight.T).astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    safe = jnp.where(labels == ignore_index, 0, labels).astype(jnp.int32)
+    tgt = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+    return jnp.where(labels == ignore_index, 0.0, lse - tgt)
+
+
+def fused_linear_cross_entropy(hidden, weight, bias=None, labels=None,
+                               ignore_index=-100, reduction="mean"):
+    """Cross-entropy of `hidden @ weight^T + bias` against `labels` without
+    materializing the [n_tokens, vocab] logits (Pallas kernel on TPU,
+    paddle_tpu.ops.pallas.fused_ce). hidden: [..., H] (flattened
+    internally); weight: [vocab, H]; labels: [...] int. The usual LM/MLM
+    loss head, fused.
+    """
+    from ...core import flags as _flags
+    h2 = ops.reshape(hidden, [-1, hidden.shape[-1]])
+    y = ops.reshape(labels, [-1])
+    n, hd = h2.shape[0], h2.shape[1]
+    from ...ops.pallas.fused_ce import supported
+    use_kernel = (_flags.flag("FLAGS_use_fused_ce")
+                  and _pallas_backend_ok()
+                  and supported(n, hd, weight.shape[0]))
+    op = _fused_ce_op if use_kernel else _ce_head_fallback
+    losses = op(h2, weight, bias, y, int(ignore_index))
+    if reduction == "none":
+        return losses
+    total = ops.sum(losses)
+    if reduction == "sum":
+        return total
+    valid = ops.sum((y != ignore_index).astype("float32"))
+    return total / ops.maximum(valid, ops.ones([], "float32"))
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64"):

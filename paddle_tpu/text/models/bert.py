@@ -116,7 +116,8 @@ class Bert(nn.Layer):
             self.nsp_head = nn.Linear(cfg.hidden_size, 2)
         _bert_init(self, std=0.02)
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None):
         x = self.embeddings(input_ids, token_type_ids)
         mask = None
         if attention_mask is not None:
@@ -128,6 +129,18 @@ class Bert(nn.Layer):
         if self.with_mlm:
             t = ops.gelu(self.mlm_transform(h))
             t = self.mlm_norm(t)
+            if masked_lm_labels is not None:
+                if self.with_nsp:
+                    raise ValueError(
+                        "masked_lm_labels returns the fused MLM loss only; "
+                        "with_nsp models must take the logits path and "
+                        "combine losses via BertPretrainingCriterion")
+                # fused head: tied-decoder projection + CE in one kernel,
+                # no [b*s, vocab] logits in HBM (ops/pallas/fused_ce.py)
+                from ...nn import functional as F
+                return F.fused_linear_cross_entropy(
+                    t, self.embeddings.word_embeddings.weight,
+                    self.mlm_bias, masked_lm_labels, ignore_index=-100)
             # weight-tied decoder: [b,s,H] @ [V,H]^T
             logits = ops.matmul(t, self.embeddings.word_embeddings.weight,
                                 transpose_y=True) + self.mlm_bias

@@ -55,9 +55,17 @@ def test_flash_matches_reference(b, h, sq, sk, d, causal, with_bias):
                                    atol=5e-5)
 
 
-def test_sdpa_routes_through_flash():
+def test_sdpa_routes_through_flash(monkeypatch):
     """The functional API picks the kernel when the flag forces interpret
     mode, and its output matches the jnp path — through the autograd tape."""
+    calls = []
+    real = F._flash_sdpa
+
+    def counted(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(F, "_flash_sdpa", counted)
     paddle.set_flags({"FLAGS_flash_attention_interpret": True})
     try:
         rng = np.random.RandomState(1)
@@ -65,6 +73,7 @@ def test_sdpa_routes_through_flash():
             rng.randn(*s).astype("float32"), stop_gradient=False)
         q, k, v = mk(2, 2, 32, 16), mk(2, 2, 32, 16), mk(2, 2, 32, 16)
         out_flash = F.scaled_dot_product_attention(q, k, v)
+        assert calls, "flash kernel was not routed to"
         paddle.set_flags({"FLAGS_flash_attention_interpret": False})
         out_ref = F.scaled_dot_product_attention(q, k, v)
         np.testing.assert_allclose(np.asarray(out_flash._value),

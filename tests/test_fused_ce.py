@@ -1,0 +1,90 @@
+"""Fused linear+cross-entropy kernel: parity with the unfused loss head."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops.pallas.fused_ce import fused_linear_cross_entropy as raw_k
+
+
+@pytest.fixture
+def interpret():
+    paddle.set_flags({"FLAGS_pallas_interpret": True})
+    yield
+    paddle.set_flags({"FLAGS_pallas_interpret": False})
+
+
+def _mk(rng, *shape):
+    return paddle.to_tensor(rng.randn(*shape).astype("float32"),
+                            stop_gradient=False)
+
+
+def test_functional_fused_vs_fallback(interpret):
+    rng = np.random.RandomState(0)
+    n, hd, v = 64, 32, 517
+    h1, w1 = _mk(rng, n, hd), _mk(rng, v, hd)
+    b1 = _mk(rng, v)
+    y = paddle.to_tensor(
+        np.where(rng.rand(n) < 0.3, -100, rng.randint(0, v, n)).astype(
+            "int64"))
+
+    loss_k = F.fused_linear_cross_entropy(h1, w1, b1, y)
+    loss_k.backward()
+    gk = [np.asarray(t.grad._value) for t in (h1, w1, b1)]
+
+    paddle.set_flags({"FLAGS_use_fused_ce": False})
+    try:
+        h2, w2, b2 = (paddle.to_tensor(np.asarray(t._value),
+                                       stop_gradient=False)
+                      for t in (h1, w1, b1))
+        loss_f = F.fused_linear_cross_entropy(h2, w2, b2, y)
+        loss_f.backward()
+        gf = [np.asarray(t.grad._value) for t in (h2, w2, b2)]
+    finally:
+        paddle.set_flags({"FLAGS_use_fused_ce": True})
+
+    np.testing.assert_allclose(float(loss_k._value), float(loss_f._value),
+                               rtol=1e-6)
+    for a, b in zip(gk, gf):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_reduction_modes(interpret):
+    rng = np.random.RandomState(1)
+    h = _mk(rng, 16, 8)
+    w = _mk(rng, 50, 8)
+    y = paddle.to_tensor(rng.randint(0, 50, 16).astype("int64"))
+    per_tok = F.fused_linear_cross_entropy(h, w, None, y, reduction="none")
+    assert tuple(per_tok.shape) == (16,)
+    s = F.fused_linear_cross_entropy(h, w, None, y, reduction="sum")
+    m = F.fused_linear_cross_entropy(h, w, None, y, reduction="mean")
+    np.testing.assert_allclose(float(s._value) / 16, float(m._value),
+                               rtol=1e-6)
+
+
+def test_bert_fused_head_matches_criterion(interpret):
+    from paddle_tpu.text.models.bert import (Bert, BertConfig,
+                                             BertPretrainingCriterion)
+    cfg = BertConfig.tiny()
+    paddle.seed(0)
+    net = Bert(cfg)
+    net.eval()
+    rng = np.random.RandomState(2)
+    b, s = 2, 16
+    ids = paddle.to_tensor(rng.randint(4, cfg.vocab_size, (b, s)).astype(
+        "int64"))
+    labels = paddle.to_tensor(
+        np.where(rng.rand(b, s) < 0.15,
+                 rng.randint(4, cfg.vocab_size, (b, s)), -100).astype(
+                     "int64"))
+
+    loss_fused = net(ids, masked_lm_labels=labels)
+    logits = net(ids)
+    loss_ref = BertPretrainingCriterion(cfg.vocab_size)(logits, labels)
+    np.testing.assert_allclose(float(loss_fused._value),
+                               float(loss_ref._value), rtol=1e-5)
+
+    loss_fused.backward()
+    g = net.embeddings.word_embeddings.weight.grad
+    assert g is not None and np.isfinite(np.asarray(g._value)).all()
