@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -27,6 +28,7 @@ WARMUP = int(os.environ.get("BENCH_WARMUP", 5))
 DTYPE = os.environ.get("BENCH_DTYPE", "bfloat16")
 PEAK_FLOPS = float(os.environ.get("BENCH_PEAK_FLOPS", 197e12))
 N_BATCHES = int(os.environ.get("BENCH_N_BATCHES", 16))
+PROFILE = os.environ.get("BENCH_PROFILE", "") not in ("", "0")
 
 
 def _build(cfg, use_fused_head):
@@ -128,18 +130,36 @@ def main():
         dt = time.perf_counter() - t0
         return dt, loss_start, loss_end
 
+    if PROFILE:
+        from paddle_tpu import profiler as prof
+        prof.reset_profiler()
+        prof.start_profiler()
+
     pallas_fallback = False
     try:
         step, params, slots, n_params = _build(cfg, use_fused_head=True)
+        if PROFILE:
+            try:
+                ca = prof.cost_analysis(
+                    step, params, slots, ids_all[0], lab_all[0], lr, t_arr,
+                    jax.random.PRNGKey(0))
+                print(f"# xla cost analysis: flops={ca.get('flops')} "
+                      f"bytes={ca.get('bytes accessed')}", file=sys.stderr)
+            except Exception as e:
+                print(f"# cost_analysis unavailable: {e}", file=sys.stderr)
         dt, loss_start, loss_end = run(step, params, slots)
     except Exception as e:  # Pallas/Mosaic failure: rerun on the jnp paths
         print(f"# pallas path failed ({type(e).__name__}: {e}); "
-              "falling back to jnp paths", flush=True)
+              "falling back to jnp paths", file=sys.stderr, flush=True)
         pallas_fallback = True
         paddle.set_flags({"FLAGS_use_flash_attention": False,
                           "FLAGS_use_fused_ce": False})
         step, params, slots, n_params = _build(cfg, use_fused_head=False)
         dt, loss_start, loss_end = run(step, params, slots)
+
+    if PROFILE:
+        prof.stop_profiler()
+        print(prof.summary(sorted_key="total"), file=sys.stderr)
 
     steps_per_sec = STEPS / dt
     samples_per_sec = steps_per_sec * BATCH

@@ -154,8 +154,18 @@ def record_op(fn: Callable, args: Sequence[Any], kwargs: dict, name: str = None)
         kw = jax.tree_util.tree_unflatten(kw_tree, full[n_args:])
         return fn(*full[:n_args], **kw)
 
+    # host-side op annotation (reference RecordEvent around the op loop,
+    # framework/operator.cc:1074); under jit this times trace/dispatch
+    _rec = None
+    if _flags.flag("FLAGS_enable_profiler"):
+        from .. import profiler as _prof
+        _rec = _prof.RecordEvent(
+            "op/" + (name or getattr(fn, "__name__", "op"))).begin()
+
     if not diff_idx:
         out_val = _call(raw)
+        if _rec is not None:
+            _rec.end()
         if _flags.flag("FLAGS_check_nan_inf"):
             from .numeric_check import check_op_outputs
             check_op_outputs(name or getattr(fn, "__name__", "op"), out_val)
@@ -168,6 +178,8 @@ def record_op(fn: Callable, args: Sequence[Any], kwargs: dict, name: str = None)
         return _call(full)
 
     out_val, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+    if _rec is not None:
+        _rec.end()
     if _flags.flag("FLAGS_check_nan_inf"):
         from .numeric_check import check_op_outputs
         check_op_outputs(name or getattr(fn, "__name__", "op"), out_val)

@@ -11,6 +11,7 @@ import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "EarlyStopping", "LRSchedulerCallback", "History",
+           "ProfilerCallback",
            "config_callbacks"]
 
 
@@ -214,6 +215,37 @@ class LRSchedulerCallback(Callback):
     def on_train_batch_end(self, step, logs=None):
         if self.by_step and self._sched() is not None:
             self._sched().step()
+
+
+class ProfilerCallback(Callback):
+    """Capture host profiler events for a window of training steps and print
+    the summary table (reference hapi callbacks + fluid/profiler.py usage;
+    device-side capture via paddle_tpu.profiler.xplane_trace)."""
+
+    def __init__(self, start_step=1, stop_step=10, sorted_key="total",
+                 xplane_dir=None):
+        self.start_step = start_step
+        self.stop_step = stop_step
+        self.sorted_key = sorted_key
+        self.xplane_dir = xplane_dir
+        self._step = 0
+
+    def on_train_batch_begin(self, step, logs=None):
+        from .. import profiler as prof
+        self._step += 1
+        if self._step == self.start_step:
+            prof.reset_profiler()
+            prof.start_profiler()
+            if self.xplane_dir:
+                prof.start_xplane(self.xplane_dir)
+
+    def on_train_batch_end(self, step, logs=None):
+        from .. import profiler as prof
+        if self._step == self.stop_step and prof.is_profiler_enabled():
+            if self.xplane_dir:
+                prof.stop_xplane()
+            prof.stop_profiler()
+            print(prof.summary(sorted_key=self.sorted_key))
 
 
 def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,

@@ -291,17 +291,21 @@ class _CompiledEngine:
         if not accumulating:
             # fast path: forward+backward+update fused in one XLA program
             if self._train_fn is None:
-                self._train_fn = self._build_train_fn()
+                from .. import profiler as _prof
+                with _prof.RecordEvent("hapi/build_train_fn"):
+                    self._train_fn = self._build_train_fn()
             amp_cfg = self.model._amp_configs
             scaler = amp_cfg.get("scaler") if amp_cfg else None
             scale_state = scaler.scale_state() if scaler is not None else {}
             opt._step_count += 1
-            lval, outs, new_bufs, new_params, new_slots, scale_state = \
-                self._train_fn(
-                    params, buffers, slots,
-                    jnp.asarray(opt.get_lr(), jnp.float32),
-                    jnp.asarray(opt._step_count, jnp.int32),
-                    _rng.next_key(), raw_in, raw_lab, scale_state)
+            from .. import profiler as _prof
+            with _prof.RecordEvent("hapi/train_step"):
+                lval, outs, new_bufs, new_params, new_slots, scale_state = \
+                    self._train_fn(
+                        params, buffers, slots,
+                        jnp.asarray(opt.get_lr(), jnp.float32),
+                        jnp.asarray(opt._step_count, jnp.int32),
+                        _rng.next_key(), raw_in, raw_lab, scale_state)
             if scaler is not None:
                 scaler.load_scale_state(scale_state)
             from ..core import flags as _flags

@@ -111,8 +111,10 @@ class Executor:
                tuple(fetch_ids), data_parallel)
         entry = self._cache.get(key)
         if entry is None:
-            entry = self._compile(program, sorted(feed_vals), fetch_ids,
-                                  data_parallel)
+            from .. import profiler as _prof
+            with _prof.RecordEvent("executor/lower_program"):
+                entry = self._compile(program, sorted(feed_vals), fetch_ids,
+                                      data_parallel)
             self._cache[key] = entry
         step, persist_names, opt = entry
 
@@ -127,9 +129,11 @@ class Executor:
             t = jnp.asarray(opt._step_count, jnp.int32)
 
         from ..core import rng as _rng
-        fetches, new_scope, new_slots = step(
-            tuple(feed_vals[n] for n in sorted(feed_vals)), scope_vals,
-            slots, lr, t, _rng.next_key())
+        from .. import profiler as _prof
+        with _prof.RecordEvent("executor/run_step"):
+            fetches, new_scope, new_slots = step(
+                tuple(feed_vals[n] for n in sorted(feed_vals)), scope_vals,
+                slots, lr, t, _rng.next_key())
 
         from ..core import flags as _flags
         if _flags.flag("FLAGS_check_nan_inf"):
