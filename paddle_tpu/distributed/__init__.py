@@ -13,6 +13,7 @@ import importlib as _importlib
 _LAZY_MODULES = ("fleet", "sharding", "pipeline", "launch", "spawn", "moe",
                  "collective", "parallel", "ring_attention")
 _LAZY_NAMES = {
+    "recompute": "recompute", "checkpoint_policy": "recompute",
     "all_gather": "collective", "all_reduce": "collective",
     "alltoall": "collective", "barrier": "collective",
     "broadcast": "collective", "recv": "collective", "reduce": "collective",

@@ -187,6 +187,18 @@ class DistributedOptimizer:
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
         strategy = self.user_defined_strategy
+        if strategy.recompute and hasattr(loss, "program"):
+            # static graph: tag the Program; the Executor lowering splits
+            # the op list at these variables and wraps each segment in
+            # jax.checkpoint (static/executor.py; reference
+            # RecomputeOptimizer fluid/optimizer.py:4526)
+            from ...static.program import default_main_program
+            program = loss.program or default_main_program()
+            cfg = strategy.recompute_configs or {}
+            program.recompute_checkpoints = tuple(
+                v.name if hasattr(v, "name") else str(v)
+                for v in cfg.get("checkpoints", ()))
+            program.recompute_policy = cfg.get("policy", "nothing")
         if strategy.amp and hasattr(loss, "program"):
             # static graph: tag the Program so the Executor applies the
             # per-op cast policy (static/amp.py)

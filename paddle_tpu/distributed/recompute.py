@@ -1,0 +1,80 @@
+"""Recompute (activation checkpointing).
+
+Analog of the reference RecomputeOptimizer (fluid/optimizer.py:4526) and
+`_append_backward_ops_with_checkpoints_` (fluid/backward.py:701): segments
+between checkpoints are re-run in the backward pass instead of storing
+their activations.
+
+TPU-native design delta: the reference rewrites the Program, duplicating
+forward ops into the backward block. Here rematerialization is a property
+of the *trace* — `jax.checkpoint` marks a function so XLA drops its
+residuals and recomputes them when the cotangents arrive. Three surfaces:
+
+- `recompute(fn, *args)` — manual wrapper (reference
+  paddle.distributed.fleet.utils.recompute);
+- `Layer.enable_recompute()` — per-layer marker consumed by Layer.__call__;
+- `DistributedStrategy.recompute` — strategy knob applied by the hapi
+  engine (transformer blocks by default / name patterns) and by the static
+  Program lowering (op-list segments split at
+  recompute_configs["checkpoints"] variables, executor.py).
+
+Policies map to jax.checkpoint_policies: "nothing" (save nothing, full
+recompute — the reference's semantics) and "dots" (save MXU matmul
+results, recompute the cheap elementwise chains — usually the best
+flops/memory trade on TPU).
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["recompute", "checkpoint_policy"]
+
+
+def checkpoint_policy(name):
+    if name in (None, "nothing", "nothing_saveable"):
+        return None  # jax.checkpoint default: save nothing
+    if name in ("dots", "dots_saveable"):
+        return jax.checkpoint_policies.dots_saveable
+    if name in ("dots_no_batch", "dots_with_no_batch_dims_saveable"):
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown recompute policy {name!r}")
+
+
+def recompute(function, *args, policy="nothing", **kwargs):
+    """Run `function(*args, **kwargs)` so its activations are rematerialized
+    in the backward pass rather than stored.
+
+    Effective inside a compiled step (hapi engine, static Executor,
+    jit-traced user steps) where jax.grad differentiates the whole trace.
+    In eager implicit-graph mode the per-op tape already owns residuals —
+    there is no XLA program to rematerialize — so this is a passthrough,
+    like the reference's recompute with no backward pass requested.
+    """
+    from ..core import tape as _tape
+    from ..core.tensor import Tensor
+
+    if _tape.is_grad_enabled():
+        return function(*args, **kwargs)
+
+    is_t = lambda x: isinstance(x, Tensor)  # noqa: E731
+    raw = [a._value if is_t(a) else a for a in args]
+    # A checkpointed function must be pure: the backward replay re-runs it,
+    # so stochastic ops (dropout) must draw the SAME keys both times. Pull
+    # one key from the ambient chain (advancing it exactly once) and re-seat
+    # the chain on it inside — replay then reproduces the forward stream.
+    from ..core import rng as _rng
+    key = _rng.next_key()
+
+    def raw_fn(key, *vals):
+        targs = [Tensor(v, _internal=True) if is_t(a) else v
+                 for a, v in zip(args, vals)]
+        with _rng.rng_state(key):
+            out = function(*targs, **kwargs)
+        return jax.tree_util.tree_map(
+            lambda t: t._value if is_t(t) else t, out, is_leaf=is_t)
+
+    pol = checkpoint_policy(policy)
+    ck = jax.checkpoint(raw_fn, policy=pol)
+    out_vals = ck(key, *raw)
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v, _internal=True), out_vals)

@@ -420,7 +420,35 @@ class Model:
             if not isinstance(m, Metric):
                 raise TypeError(f"metrics must be Metric instances, got {m}")
         self._amp_configs = self._parse_amp(amp_configs)
+        self._apply_strategy_recompute()
         return self
+
+    def _apply_strategy_recompute(self):
+        """strategy.recompute -> Layer.enable_recompute on the designated
+        blocks (reference RecomputeOptimizer applied via fleet strategy;
+        fluid/optimizer.py:4526). recompute_configs:
+          - "layers": fnmatch patterns over named_sublayers, or
+          - default: every TransformerEncoderLayer/TransformerDecoderLayer.
+        """
+        strat = getattr(self._optimizer, "_dist_strategy", None)
+        if strat is None or not getattr(strat, "recompute", False):
+            return
+        cfg = getattr(strat, "recompute_configs", {}) or {}
+        policy = cfg.get("policy", "nothing")
+        patterns = cfg.get("layers")
+        net = self.network
+        if patterns:
+            import fnmatch
+            hits = [sub for name, sub in net.named_sublayers()
+                    if any(fnmatch.fnmatch(name, p) for p in patterns)]
+        else:
+            from ..nn.layer.transformer import (TransformerDecoderLayer,
+                                                TransformerEncoderLayer)
+            hits = [sub for _, sub in net.named_sublayers()
+                    if isinstance(sub, (TransformerEncoderLayer,
+                                        TransformerDecoderLayer))]
+        for sub in hits:
+            sub.enable_recompute(policy=policy)
 
     def _parse_amp(self, amp_configs):
         """amp_configs: None | 'O1'/'O2' | dict (reference hapi/model.py

@@ -116,6 +116,8 @@ class Layer:
         self._forward_pre_hooks = OrderedDict()
         self._forward_post_hooks = OrderedDict()
         self._hook_id = 0
+        self._recompute = False
+        self._recompute_policy = "nothing"
 
     # -- construction -------------------------------------------------------
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
@@ -334,12 +336,28 @@ class Layer:
             result = hook(self, inputs)
             if result is not None:
                 inputs = result if isinstance(result, tuple) else (result,)
-        out = self.forward(*inputs, **kwargs)
+        if getattr(self, "_recompute", False):
+            from ...distributed.recompute import recompute as _rc
+            out = _rc(self.forward, *inputs,
+                      policy=self._recompute_policy, **kwargs)
+        else:
+            out = self.forward(*inputs, **kwargs)
         for hook in self._forward_post_hooks.values():
             result = hook(self, inputs, out)
             if result is not None:
                 out = result
         return out
+
+    # -- recompute (activation checkpointing) -------------------------------
+    def enable_recompute(self, policy="nothing"):
+        """Rematerialize this layer's activations in the backward pass
+        (reference RecomputeOptimizer, fluid/optimizer.py:4526; here a
+        jax.checkpoint around forward — see distributed/recompute.py)."""
+        self._recompute = True
+        self._recompute_policy = policy
+
+    def disable_recompute(self):
+        self._recompute = False
 
     # -- state dict ---------------------------------------------------------
     def state_dict(self, include_sublayers=True, use_hook=True):

@@ -149,6 +149,41 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
+    @staticmethod
+    def _recompute_segments(program, ops, fetch_ids, persist, state_writes,
+                            bwd):
+        """Split the op list at recompute checkpoint variables and compute
+        each boundary's live set (vars read by any later op, fetched,
+        persisted, or state-written) so segment outputs can be pruned to
+        exactly what must be saved."""
+        ck_names = getattr(program, "recompute_checkpoints", None)
+        if not ck_names:
+            return None
+        names = set(ck_names)
+        ck_ids = {v.var_id for v in program.list_vars() if v.name in names}
+        cuts = sorted({i + 1 for i, op in enumerate(program.ops)
+                       if any(oid in ck_ids for oid in op.out_ids)})
+        cuts = [c for c in cuts if c < len(ops)]
+        if not cuts:
+            return None
+        bounds = [0] + cuts + [len(ops)]
+        segments = [(bounds[i], bounds[i + 1])
+                    for i in range(len(bounds) - 1)]
+        final_needed = set(fetch_ids) | {vid for _, vid in persist} \
+            | set(state_writes.values())
+        if bwd is not None:
+            final_needed.add(bwd[0].var_id)
+        read_sets = [{x.var_id for x in op.flat if isinstance(x, _Ref)}
+                     for op in program.ops]
+        live_out = []
+        for _lo, hi in segments:
+            needed = set(final_needed)
+            for rs in read_sets[hi:]:
+                needed |= rs
+            live_out.append(frozenset(needed))
+        policy = getattr(program, "recompute_policy", "nothing")
+        return segments, live_out, policy
+
     # -- lowering ------------------------------------------------------------
     def _compile(self, program: Program, feed_names, fetch_ids,
                  data_parallel):
@@ -173,8 +208,8 @@ class Executor:
                 "need_clip": getattr(p, "need_clip", True)}
                 for p, _ in opt_sec[1]}
 
-        def run_ops(env):
-            for fn, flat, n_args, kw_tree, out_ids, opname in ops:
+        def run_op_range(env, op_range):
+            for fn, flat, n_args, kw_tree, out_ids, opname in op_range:
                 vals = [_resolve(x, env) for x in flat]
                 if amp_level:  # program-level AMP (paddle_tpu.static.amp)
                     from .. import amp as amp_mod
@@ -187,6 +222,31 @@ class Executor:
                 else:
                     for oid, val in zip(out_ids, out):
                         env[oid] = val
+            return env
+
+        recompute_segments = self._recompute_segments(
+            program, ops, fetch_ids, persist, state_writes, bwd)
+
+        def run_ops(env):
+            if recompute_segments is None:
+                return run_op_range(env, ops)
+            # recompute: each segment's intermediates are rematerialized in
+            # the backward pass; only each boundary's live set is saved
+            # (reference backward.py:701; here jax.checkpoint over env-dict
+            # segment functions with liveness-pruned boundaries)
+            segments, live_out, policy = recompute_segments
+            from ..distributed.recompute import checkpoint_policy
+            pol = checkpoint_policy(policy)
+            for idx, (lo, hi) in enumerate(segments):
+                seg_ops = ops[lo:hi]
+                keep = live_out[idx]
+
+                def seg_fn(e, _ops=seg_ops, _keep=keep):
+                    e = dict(e)
+                    e = run_op_range(e, _ops)
+                    return {k: v for k, v in e.items() if k in _keep}
+
+                env = jax.checkpoint(seg_fn, policy=pol)(env)
             return env
 
         def step(feed_tuple, scope_vals, slots, lr, t, key):

@@ -1,0 +1,181 @@
+"""Recompute / activation checkpointing (VERDICT r02 item 4; reference
+RecomputeOptimizer fluid/optimizer.py:4526, backward.py:701).
+
+Correctness contract: gradients with recompute on must equal gradients
+with it off — rematerialization changes memory, never math.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core import rng as _rng
+from paddle_tpu.core import tape as _tape
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import fleet, recompute
+
+
+def _mlp():
+    paddle.seed(3)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 16),
+                         nn.GELU(), nn.Linear(16, 4))
+
+
+def test_manual_recompute_grads_match():
+    net = _mlp()
+    params, buffers = net.functional_state()
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8), jnp.float32)
+
+    def loss_fn(p, use_rc):
+        with _tape.no_grad(), _rng.rng_state(jax.random.PRNGKey(0)):
+            net.load_functional_state(p, buffers)
+            xt = Tensor(x, _internal=True)
+            if use_rc:
+                h = recompute(net[0], xt)        # single layer
+                h = recompute(lambda t: net[3](net[2](net[1](t))), h,
+                              policy="dots")     # a segment, dots policy
+                out = net[4](h)
+            else:
+                out = net(xt)
+            return (out._value ** 2).mean()
+
+    l0, g0 = jax.value_and_grad(lambda p: loss_fn(p, False))(params)
+    l1, g1 = jax.value_and_grad(lambda p: loss_fn(p, True))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for k in g0:
+        np.testing.assert_allclose(np.asarray(g0[k]), np.asarray(g1[k]),
+                                   atol=1e-6, err_msg=k)
+
+
+def test_manual_recompute_eager_passthrough():
+    net = _mlp()
+    x = paddle.randn([4, 8])
+    x.stop_gradient = False
+    out = recompute(net, x)  # eager: passthrough, still differentiable
+    out.sum().backward()
+    assert net[0].weight.grad is not None
+
+
+def test_layer_enable_recompute_in_hapi_fit():
+    """strategy.recompute through Model.prepare: transformer blocks get
+    wrapped, loss/grads stay identical to the plain run."""
+    from paddle_tpu.io import TensorDataset
+
+    def build(with_rc):
+        paddle.seed(11)
+        net = nn.Sequential(
+            nn.Embedding(64, 16),
+            nn.TransformerEncoder(
+                nn.TransformerEncoderLayer(16, 2, 32, dropout=0.0), 2),
+            nn.Linear(16, 8))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+        if with_rc:
+            strategy = fleet.DistributedStrategy()
+            strategy.recompute = True
+            opt = fleet.distributed_optimizer(opt, strategy)
+        model = paddle.Model(net)
+        model.prepare(optimizer=opt, loss=nn.CrossEntropyLoss())
+        return net, model
+
+    rng = np.random.RandomState(5)
+    X = rng.randint(0, 64, (32, 12)).astype("int64")
+    Y = rng.randint(0, 8, (32, 12)).astype("int64")
+    hist = []
+    for with_rc in (False, True):
+        net, model = build(with_rc)
+        if with_rc:
+            enc_layers = [s for _, s in net.named_sublayers()
+                          if isinstance(s, nn.TransformerEncoderLayer)]
+            assert enc_layers and all(s._recompute for s in enc_layers)
+        from paddle_tpu.hapi.callbacks import History
+        h = History()
+        paddle.seed(42)  # identical step keys / batch order for both runs
+        model.fit(TensorDataset([X, Y]), batch_size=16, epochs=2, verbose=0,
+                  shuffle=False, callbacks=[h])
+        hist.append(h.history["loss"])
+    np.testing.assert_allclose(hist[0], hist[1], rtol=1e-5)
+
+
+def test_static_recompute_segments():
+    """Static Program: checkpoints split the op list; fetches and loss
+    match the unsegmented lowering."""
+    def run(with_rc):
+        paddle.enable_static()
+        try:
+            import paddle_tpu.static as static
+            paddle.seed(7)
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", [4, 8], "float32")
+                h1 = nn.Linear(8, 16)(x)
+                h1 = paddle.tanh(h1) if hasattr(paddle, "tanh") else h1
+                h2 = nn.Linear(16, 16)(h1)
+                out = nn.Linear(16, 1)(h2)
+                loss = paddle.mean(out) if hasattr(paddle, "mean") else out
+                opt = optimizer.SGD(learning_rate=0.1)
+                if with_rc:
+                    strategy = fleet.DistributedStrategy()
+                    strategy.recompute = True
+                    strategy.recompute_configs = {
+                        "checkpoints": [h1.name, h2.name]}
+                    opt = fleet.distributed_optimizer(opt, strategy)
+                opt.minimize(loss)
+            exe = static.Executor()
+            exe.run(startup)
+            xs = np.random.RandomState(1).randn(4, 8).astype("float32")
+            vals = [exe.run(main, feed={"x": xs},
+                            fetch_list=[loss])[0] for _ in range(3)]
+            return [float(np.asarray(v)) for v in vals]
+        finally:
+            paddle.disable_static()
+
+    base = run(False)
+    rc = run(True)
+    np.testing.assert_allclose(base, rc, rtol=1e-5)
+    assert base[0] != base[-1]  # training actually moved
+
+
+def test_tp_plus_recompute_dryrun_mesh():
+    """BASELINE config 5 shape: model-parallel + recompute on the 8-device
+    mesh — a full fwd+bwd step compiles and yields a finite loss."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.sharding import param_spec_for
+    from paddle_tpu.text.models.bert import Bert, BertConfig, \
+        BertPretrainingCriterion
+
+    mesh = mesh_mod.init_mesh({"dp": 4, "tp": 2})
+    cfg = BertConfig.tiny()
+    paddle.seed(0)
+    net = Bert(cfg)
+    net.train()
+    for _, sub in net.named_sublayers():
+        if isinstance(sub, nn.TransformerEncoderLayer):
+            sub.enable_recompute(policy="dots")
+    criterion = BertPretrainingCriterion(cfg.vocab_size)
+    params, buffers = net.functional_state()
+    shardings = {k: NamedSharding(mesh, param_spec_for(k, v.ndim))
+                 for k, v in params.items()}
+    data_sh = NamedSharding(mesh, P("dp"))
+
+    def step(p, ids, labels, key):
+        with _rng.rng_state(key), _tape.no_grad():
+            def loss_of(pp):
+                net.load_functional_state(pp, buffers)
+                logits = net(Tensor(ids, _internal=True))
+                return criterion(logits,
+                                 Tensor(labels, _internal=True))._value
+            return jax.value_and_grad(loss_of)(p)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(4, cfg.vocab_size, (8, 16)), jnp.int64)
+    labels = jnp.asarray(np.where(rng.rand(8, 16) < 0.15,
+                                  rng.randint(4, cfg.vocab_size, (8, 16)),
+                                  -100), jnp.int64)
+    jstep = jax.jit(step, in_shardings=(shardings, data_sh, data_sh, None))
+    with mesh:
+        loss, grads = jstep(params, ids, labels, jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g)).all() for g in grads.values())
+    mesh_mod.init_mesh({"dp": 8})
