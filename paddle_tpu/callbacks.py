@@ -1,0 +1,9 @@
+"""paddle.callbacks — hapi callback re-exports (reference
+python/paddle/callbacks.py does exactly this over hapi/callbacks.py)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, CallbackList, EarlyStopping, History, LRSchedulerCallback,
+    ModelCheckpoint, ProfilerCallback, ProgBarLogger)
+
+__all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRSchedulerCallback", "History",
+           "ProfilerCallback"]

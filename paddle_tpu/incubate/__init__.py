@@ -1,0 +1,19 @@
+"""paddle.incubate — graduated-experimental APIs (reference
+python/paddle/fluid/incubate/: auto-checkpoint, fleet utils, ...).
+
+Here: `incubate.functional` (higher-order autodiff over Tensor functions)
+and `incubate.checkpoint` (preemption-safe training checkpoints, the
+reference fluid/incubate/checkpoint/auto_checkpoint.py analog).
+"""
+import importlib as _importlib
+
+_SUBMODULES = ("functional", "checkpoint")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        mod = _importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(
+        f"module 'paddle_tpu.incubate' has no attribute {name!r}")

@@ -1,0 +1,74 @@
+"""Higher-order autodiff over Tensor-level functions.
+
+The eager tape (core/tape.py) is first-order by design — create_graph-style
+double backward would need grad-of-grad graphs the reference builds with
+nested GradOpDescMakers. TPU-natively that's just functional transform
+composition: lift a Tensor function to raw arrays once, then let jax.grad /
+jacfwd / jacrev / hessian stack arbitrarily (promised by
+paddle_tpu.autograd.grad's error message, core/tape.py).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core import tape as _tape
+from ..core.tensor import Tensor
+
+__all__ = ["as_raw_fn", "grad", "value_and_grad", "jacobian", "hessian",
+           "vjp", "jvp"]
+
+
+def as_raw_fn(fn):
+    """Lift a Tensor->Tensor function to a pure jax-array function (scalars
+    pass through). The body runs eager-over-trace with the tape off, so it
+    composes under any jax transform."""
+    def raw(*args):
+        with _tape.no_grad():
+            t_args = [Tensor(a, _internal=True) for a in args]
+            out = fn(*t_args)
+        is_t = lambda x: isinstance(x, Tensor)  # noqa: E731
+        return jax.tree_util.tree_map(
+            lambda t: t._value if is_t(t) else t, out, is_leaf=is_t)
+    return raw
+
+
+def _unwrap(a):
+    return a._value if isinstance(a, Tensor) else a
+
+
+def _wrap(v):
+    return jax.tree_util.tree_map(lambda x: Tensor(x, _internal=True), v)
+
+
+def grad(fn, argnums=0):
+    """d(scalar fn)/d(args). Composable: grad(grad(f)) is double backward."""
+    g = jax.grad(as_raw_fn(fn), argnums=argnums)
+    return lambda *args: _wrap(g(*[_unwrap(a) for a in args]))
+
+
+def value_and_grad(fn, argnums=0):
+    vg = jax.value_and_grad(as_raw_fn(fn), argnums=argnums)
+    return lambda *args: _wrap(vg(*[_unwrap(a) for a in args]))
+
+
+def jacobian(fn, argnums=0, mode="rev"):
+    jac = (jax.jacrev if mode == "rev" else jax.jacfwd)(
+        as_raw_fn(fn), argnums=argnums)
+    return lambda *args: _wrap(jac(*[_unwrap(a) for a in args]))
+
+
+def hessian(fn, argnums=0):
+    h = jax.hessian(as_raw_fn(fn), argnums=argnums)
+    return lambda *args: _wrap(h(*[_unwrap(a) for a in args]))
+
+
+def vjp(fn, *primals):
+    out, pullback = jax.vjp(as_raw_fn(fn), *[_unwrap(p) for p in primals])
+    return _wrap(out), lambda ct: _wrap(pullback(_unwrap(ct)))
+
+
+def jvp(fn, primals, tangents):
+    out, tan = jax.jvp(as_raw_fn(fn),
+                       tuple(_unwrap(p) for p in primals),
+                       tuple(_unwrap(t) for t in tangents))
+    return _wrap(out), _wrap(tan)

@@ -208,6 +208,72 @@ def save(layer, path, input_spec=None, **configs):
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(payload, f, protocol=4)
     _save(layer.state_dict(), path + ".pdiparams")
+    _export_stablehlo(layer, input_spec, [v.name for v in feeds], path)
+
+
+def _export_stablehlo(layer, input_spec, feed_names, path):
+    """Freeze the eval-mode forward (parameters baked as constants) into a
+    serialized jax.export/StableHLO artifact + a JSON metadata sidecar —
+    the deployment artifact paddle_tpu.inference.Predictor consumes
+    (reference save_inference_model, fluid/io.py:1198; the ~30-pass
+    OptimizeInferenceProgram pipeline collapses into XLA compilation of
+    the exported module)."""
+    import json
+
+    import jax
+    import jax.export as jexport
+    import jax.numpy as jnp
+
+    from ..core import rng as _rng
+
+    was_training = layer.training
+    layer.eval()
+    try:
+        params, buffers = layer.functional_state()
+
+        def fwd(*xs):
+            with _tape.no_grad(), _rng.rng_state(jax.random.PRNGKey(0)):
+                layer.load_functional_state(params, buffers)
+                out = layer(*[Tensor(x, _internal=True) for x in xs])
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            return tuple(o._value for o in outs)
+
+        from ..core.dtype import to_jax_dtype
+        example = []
+        for i, spec in enumerate(input_spec):
+            shape = [1 if (s is None or s == -1) else s for s in spec.shape]
+            example.append(
+                jnp.zeros(shape, to_jax_dtype(spec.dtype)))
+
+        args = example
+        try:  # symbolic batch dim where the spec left it open
+            poly = [(", ".join(["b"] + ["_"] * (a.ndim - 1))
+                     if (spec.shape and spec.shape[0] in (None, -1)
+                         and a.ndim >= 1) else None)
+                    for spec, a in zip(input_spec, example)]
+            if any(p is not None for p in poly):
+                args = jexport.symbolic_args_specs(example, poly)
+        except Exception:
+            args = example
+
+        exported = jexport.export(jax.jit(fwd), platforms=("cpu", "tpu"))(
+            *args)
+        out_avals = exported.out_avals
+        with open(path + ".stablehlo", "wb") as f:
+            f.write(bytes(exported.serialize()))
+        meta = {
+            "input_names": list(feed_names),
+            "input_dtypes": [str(np.dtype(a.dtype)) for a in example],
+            "output_names": [f"fetch_{i}" for i in range(len(out_avals))],
+            "output_shapes": [[int(d) if str(d).isdigit() else None
+                               for d in a.shape] for a in out_avals],
+            "format": "stablehlo+jax.export",
+        }
+        with open(path + ".pdinfer.json", "w") as f:
+            json.dump(meta, f)
+    finally:
+        if was_training:
+            layer.train()
 
 
 def load(path, **configs):

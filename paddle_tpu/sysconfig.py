@@ -1,0 +1,21 @@
+"""paddle.sysconfig — installation introspection (reference
+python/paddle/sysconfig.py: get_include/get_lib for building C++ extensions
+against the framework)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include():
+    """Directory of C headers (reference sysconfig.get_include). The
+    TPU-native runtime's native pieces live under _native/include."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(pkg, "_native", "include")
+
+
+def get_lib():
+    """Directory of shared libraries."""
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    return os.path.join(pkg, "_native", "lib")
