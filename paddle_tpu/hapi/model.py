@@ -530,7 +530,14 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None,
+            auto_checkpoint_dir=None, auto_checkpoint_freq=50,
+            keep_checkpoint_max=3):
+        """... `auto_checkpoint_dir` enables preemption-safe training:
+        async step-atomic checkpoints (params, optimizer, scaler, rng,
+        counters) every `auto_checkpoint_freq` steps, keep-latest-
+        `keep_checkpoint_max`, and resume-from-latest on the next fit()
+        (reference fluid/incubate/checkpoint/auto_checkpoint.py:71)."""
         from ..io import DataLoader, Dataset
 
         assert self._optimizer is not None and self._loss is not None, \
@@ -557,12 +564,33 @@ class Model:
                                 save_freq=save_freq, save_dir=save_dir,
                                 verbose=verbose,
                                 metrics=self._metrics_name())
+        acp, start_epoch, skip_steps = None, 0, 0
+        if auto_checkpoint_dir is not None:
+            from ..incubate.checkpoint import TrainingCheckpoint
+            acp = TrainingCheckpoint(auto_checkpoint_dir,
+                                     keep=keep_checkpoint_max,
+                                     save_interval_steps=auto_checkpoint_freq)
+            counters = acp.restore_into(self)
+            if counters is not None:
+                self._global_step = counters["global_step"]
+                start_epoch = counters["epoch"]
+                skip_steps = counters["step"] + 1
+                if steps is not None and skip_steps >= steps:
+                    start_epoch, skip_steps = start_epoch + 1, 0
+            else:
+                self._global_step = 0
+        self._acp = acp
+
         cbks.on_begin("train")
-        for epoch in range(epochs):
+        logs = {}
+        for epoch in range(start_epoch, epochs):
             cbks.on_epoch_begin(epoch)
             logs = self._run_one_epoch(train_loader, cbks, "train",
                                        num_iters=num_iters,
-                                       accum=accumulate_grad_batches)
+                                       accum=accumulate_grad_batches,
+                                       epoch=epoch,
+                                       skip_steps=skip_steps)
+            skip_steps = 0
             cbks.on_epoch_end(epoch, logs)
             if do_eval and epoch % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, callbacks=cbks,
@@ -570,6 +598,8 @@ class Model:
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
             if self.stop_training:
                 break
+        if acp is not None:
+            acp.wait()
         cbks.on_end("train", logs)
         return self
 
@@ -619,11 +649,15 @@ class Model:
             merged = [np.concatenate(m) for m in merged]
         return merged
 
-    def _run_one_epoch(self, loader, cbks, mode, num_iters=None, accum=1):
+    def _run_one_epoch(self, loader, cbks, mode, num_iters=None, accum=1,
+                       epoch=0, skip_steps=0):
         for m in self._metrics:
             m.reset()
         logs = {}
+        acp = getattr(self, "_acp", None)
         for step, batch in enumerate(loader):
+            if step < skip_steps:
+                continue  # resumed mid-epoch: fast-forward consumed batches
             cbks.on_batch_begin(mode, step, logs)
             inputs, labels = self._split_batch(batch)
             update = accum <= 1 or (step + 1) % accum == 0
@@ -636,6 +670,9 @@ class Model:
             metric_logs = self._update_metrics(outs, labels)
             logs.update(metric_logs)
             cbks.on_batch_end(mode, step, logs)
+            if acp is not None and mode == "train":
+                self._global_step = getattr(self, "_global_step", 0) + 1
+                acp.maybe_save(self, epoch, step, self._global_step)
             if num_iters is not None and step + 1 >= num_iters:
                 break
         if self._lr_sched_step_on_epoch():
