@@ -16,14 +16,32 @@ import jax
 
 
 class Generator:
-    """A splittable PRNG chain. `next_key()` advances the chain."""
+    """A splittable PRNG chain. `next_key()` advances the chain.
+
+    Key creation is LAZY (first use, not construction): the module-level
+    default generator is built at import time, and materializing a key
+    there would initialize the XLA backend — which must not happen before
+    a multi-host job calls jax.distributed.initialize
+    (distributed/bootstrap.py)."""
 
     def __init__(self, seed: int = 0):
-        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+        self._key_ = None
         self._lock = threading.Lock()
 
+    @property
+    def _key(self):
+        if self._key_ is None:
+            self._key_ = jax.random.PRNGKey(self._seed)
+        return self._key_
+
+    @_key.setter
+    def _key(self, value):
+        self._key_ = value
+
     def manual_seed(self, seed: int):
-        self._key = jax.random.PRNGKey(seed)
+        self._seed = seed
+        self._key_ = None  # stays lazy: re-materialized on next use
         return self
 
     def seat(self, key):

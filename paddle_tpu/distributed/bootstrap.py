@@ -1,0 +1,54 @@
+"""Multi-host bootstrap: the PADDLE_* env contract -> jax.distributed.
+
+Analog of the reference's NCCL-id TCP rendezvous
+(operators/collective/gen_nccl_id_op_helper.cc:205,277 — rank 0 listens,
+others connect, then c_comm_init builds the rings) and its env protocol
+(distributed/utils.py:406-409). TPU-native design: instead of exchanging
+communicator ids, processes join JAX's coordination service over DCN —
+PADDLE_TRAINER_ENDPOINTS[0] is the coordinator, PADDLE_TRAINER_ID the
+process id — after which `jax.devices()` is the *global* device set and
+mesh axes span hosts; collectives ride ICI within a slice and DCN across
+(SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["maybe_initialize_distributed", "is_initialized"]
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def maybe_initialize_distributed(timeout_s: int = 120) -> bool:
+    """Join the multi-host coordination service when the PADDLE_* env
+    contract declares more than one trainer. Idempotent; single-process
+    jobs (or already-initialized runtimes) are a no-op. Returns True if
+    this call (or a previous one) initialized multi-host mode."""
+    global _initialized
+    if _initialized:
+        return True
+    n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    endpoints = [e for e in os.environ.get(
+        "PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+    if n <= 1 or not endpoints:
+        return False
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if len(endpoints) != n:
+        raise ValueError(
+            f"PADDLE_TRAINER_ENDPOINTS has {len(endpoints)} entries but "
+            f"PADDLE_TRAINERS_NUM={n}")
+
+    import jax
+    coordinator = endpoints[0]  # rank 0's endpoint doubles as coordinator,
+    # exactly like the reference's rank-0 TCP rendezvous server
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=n,
+        process_id=rank,
+        initialization_timeout=timeout_s)
+    _initialized = True
+    return True

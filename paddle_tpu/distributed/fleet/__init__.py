@@ -44,8 +44,10 @@ def init(role_maker=None, is_collective=True, strategy=None):
     strategy = strategy or DistributedStrategy()
     _fleet_state.update(initialized=True, role_maker=role_maker,
                         strategy=strategy, is_collective=is_collective)
+    from ..bootstrap import maybe_initialize_distributed
+    maybe_initialize_distributed()
     import jax
-    n = len(jax.devices())
+    n = len(jax.devices())  # global across hosts once bootstrapped
     degrees = strategy.hybrid_configs
     dp = degrees.get("dp_degree", -1)
     mp = degrees.get("mp_degree", 1)

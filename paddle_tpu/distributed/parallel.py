@@ -26,9 +26,11 @@ __all__ = ["init_parallel_env", "DataParallel", "ParallelEnv",
 
 
 def init_parallel_env(mesh_shape=None):
-    """Declare the default mesh (the c_gen_nccl_id + c_comm_init analog,
-    minus the TCP rendezvous — the jax runtime already knows the devices).
-    """
+    """Join the multi-host runtime (when PADDLE_TRAINERS_NUM > 1, via
+    jax.distributed — see bootstrap.py, the c_gen_nccl_id + c_comm_init
+    analog) and declare the default mesh over the global device set."""
+    from .bootstrap import maybe_initialize_distributed
+    maybe_initialize_distributed()
     mesh_mod.init_mesh(mesh_shape)
     return ParallelEnv()
 
