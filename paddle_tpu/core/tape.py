@@ -210,14 +210,16 @@ def _record_static(fn, flat, n_args, kw_tree, name):
     """Append an op to the current static Program and return symbolic
     Variables with shapes inferred via jax.eval_shape (the analog of the
     reference's compile-time InferShape, framework/op_desc.cc)."""
-    from ..static.program import Variable, default_main_program
+    from ..static.program import (Variable, default_main_program,
+                                  forced_program)
     from .tensor import Tensor
 
-    program = None
-    for a in flat:
-        if isinstance(a, Variable) and a.program is not None:
-            program = a.program
-            break
+    program = forced_program()
+    if program is None:
+        for a in flat:
+            if isinstance(a, Variable) and a.program is not None:
+                program = a.program
+                break
     program = program or default_main_program()
 
     def is_dyn(a):

@@ -169,3 +169,8 @@ class _StaticNN:
 
 
 nn = _StaticNN()
+
+from .control_flow import cond, while_loop  # noqa: E402,F401
+
+nn.while_loop = while_loop  # instance attrs: plain functions, unbound
+nn.cond = cond

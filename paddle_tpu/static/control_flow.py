@@ -1,0 +1,291 @@
+"""Static-graph control flow: while_loop / cond with Program sub-blocks.
+
+Analog of the reference's control-flow operators
+(operators/controlflow/while_op.cc, conditional_block_op.cc — ops that OWN
+sub-blocks and run them with a nested executor;
+fluid/layers/control_flow.py while_loop :1096, cond :2334).
+
+TPU-native design delta: the reference interprets sub-blocks op-by-op at
+runtime with scope copy-in/copy-out. Here a sub-block is a traced op list
+(SubBlock) closed over by a single recorded op whose kernel lowers to
+`lax.while_loop` / `lax.cond` — XLA compiles the loop as a native HLO
+While/Conditional with the sub-block fused inside, no interpreter at
+runtime. Free outer variables are promoted to explicit op inputs (the
+reference's scope-parent-chain lookup, made SSA).
+
+Shape invariants are checked at build time (lax.while_loop requires carry
+avals fixed), matching the reference's sub-block var shape checks.
+
+Differentiation: `cond` differentiates (lax.cond has a vjp). A `while_loop`
+with data-dependent trip count has no reverse-mode derivative in XLA —
+pass `maximum_trip_count` to lower onto a masked `lax.scan`, which is
+differentiable (the reference's WhileGrad records per-iteration scopes for
+the same reason: bounded storage).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+from jax import lax
+
+from .program import (Program, Variable, _Ref, default_main_program,
+                      force_program, in_static_mode, program_guard)
+
+__all__ = ["while_loop", "cond", "SubBlock"]
+
+
+class SubBlock:
+    """A picklable traced sub-program: op list + placeholder/free/output
+    ids. The runtime analog of the reference's BlockDesc owned by a
+    control-flow op."""
+
+    def __init__(self, ops, in_ids, free_ids, out_ids):
+        self.ops = list(ops)
+        self.in_ids = list(in_ids)
+        self.free_ids = list(free_ids)
+        self.out_ids = list(out_ids)
+
+    def run(self, carry_vals, free_vals):
+        env = dict(zip(self.in_ids, carry_vals))
+        env.update(zip(self.free_ids, free_vals))
+        for op in self.ops:
+            vals = [env[x.var_id] if isinstance(x, _Ref) else x
+                    for x in op.flat]
+            kw = jtu.tree_unflatten(op.kw_tree, vals[op.n_args:])
+            out = op.fn(*vals[:op.n_args], **kw)
+            if len(op.out_ids) == 1 and not isinstance(out, (tuple, list)):
+                env[op.out_ids[0]] = out
+            else:
+                for oid, v in zip(op.out_ids, out):
+                    env[oid] = v
+        return [env[i] for i in self.out_ids]
+
+
+def _aval(v):
+    """Shape/dtype of a loop var: symbolic Variable or eager initial value
+    (constants like ops.zeros run eagerly even in static mode — they are
+    legitimate carry initials, baked as op inputs)."""
+    if isinstance(v, Variable):
+        return v.aval
+    import numpy as np
+    from ..core.tensor import Tensor
+    raw = v._value if isinstance(v, Tensor) else np.asarray(v)
+    return jax.ShapeDtypeStruct(tuple(raw.shape), raw.dtype)
+
+
+def _trace_subblock(fn, arg_vars, name):
+    """Trace `fn` over fresh placeholders into its own Program; returns
+    (ops, placeholder_ids, out_vars, free_ids)."""
+    sub = Program(name)
+    ph = [Variable(_aval(v).shape, _aval(v).dtype, program=sub)
+          for v in arg_vars]
+    with program_guard(sub), force_program(sub):
+        out = fn(*ph)
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    for o in outs:
+        if not isinstance(o, Variable):
+            raise TypeError(
+                f"{name}: sub-block functions must return static Variables "
+                f"(got {type(o).__name__}); return values must be computed "
+                "from the loop variables / captured Variables")
+    produced = {oid for op in sub.ops for oid in op.out_ids}
+    produced |= {p.var_id for p in ph}
+    seen = {}
+    for op in sub.ops:
+        for x in op.flat:
+            if isinstance(x, _Ref) and x.var_id not in produced:
+                seen[x.var_id] = x.name
+    # an output may be a passthrough of a placeholder or outer var
+    for o in outs:
+        if o.var_id not in produced:
+            seen[o.var_id] = o.name
+    return sub.ops, [p.var_id for p in ph], outs, seen
+
+
+def _resolve_free(free_map):
+    """free var_id -> the actual outer Variable objects (promoted to op
+    inputs; the SSA form of the reference's parent-scope lookup)."""
+    main = default_main_program()
+    by_id = {}
+    for v in main.data_vars.values():
+        by_id[v.var_id] = v
+    for v in main.persistable_vars.values():
+        by_id[v.var_id] = v
+    for op in main.ops:
+        for v in op.out_vars:
+            by_id[v.var_id] = v
+    missing = [name for vid, name in free_map.items() if vid not in by_id]
+    if missing:
+        raise ValueError(
+            f"control-flow sub-block captured variables not visible in the "
+            f"current program: {missing}; pass them through loop_vars or "
+            "build them in the same program")
+    return [by_id[vid] for vid in free_map]
+
+
+def _check_scalar_bool(var, what):
+    size = 1
+    for s in var.aval.shape:
+        size *= s
+    if size != 1:
+        raise ValueError(
+            f"{what} must produce a scalar boolean, got shape "
+            f"{tuple(var.aval.shape)}")
+
+
+class _WhileFn:
+    """Kernel of a recorded while op: lax.while_loop over SubBlocks
+    (pickles structurally with the Program — no registry entry needed)."""
+
+    def __init__(self, cond_block, body_block, n_loop, max_trip=None):
+        self.cond_block = cond_block
+        self.body_block = body_block
+        self.n_loop = n_loop
+        self.max_trip = max_trip
+
+    def __call__(self, *vals):
+        init = tuple(vals[:self.n_loop])
+        free = tuple(vals[self.n_loop:])
+
+        def c(carry):
+            r = self.cond_block.run(list(carry), free)[0]
+            return jnp.reshape(r, ()).astype(bool)
+
+        def b(carry):
+            outs = self.body_block.run(list(carry), free)
+            return tuple(jnp.asarray(o).astype(i.dtype).reshape(i.shape)
+                         for o, i in zip(outs, carry))
+
+        if self.max_trip is None:
+            return lax.while_loop(c, b, init)
+
+        # bounded, differentiable form: scan max_trip steps, freeze the
+        # carry once the predicate goes false (reference WhileGrad's
+        # bounded per-iteration storage, made explicit)
+        def step(carry, _):
+            alive = c(carry)
+            new = b(carry)
+            keep = tuple(jnp.where(alive, n, o) for n, o in zip(new, carry))
+            return keep, None
+
+        final, _ = lax.scan(step, init, None, length=self.max_trip)
+        return final
+
+
+class _CondFn:
+    def __init__(self, true_block, false_block):
+        self.true_block = true_block
+        self.false_block = false_block
+
+    def __call__(self, pred, *free):
+        p = jnp.reshape(pred, ()).astype(bool)
+
+        def t(fv):
+            return tuple(self.true_block.run([], list(fv)))
+
+        def f(fv):
+            return tuple(self.false_block.run([], list(fv)))
+
+        return lax.cond(p, t, f, tuple(free))
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None,
+               maximum_trip_count=None):
+    """reference fluid/layers/control_flow.py:1096 while_loop.
+
+    Static mode: records ONE op lowering to lax.while_loop (or a masked
+    lax.scan when `maximum_trip_count` is given — required if gradients
+    must flow through the loop). Dygraph: a plain Python loop.
+    """
+    loop_vars = list(loop_vars)
+    if not loop_vars:
+        raise ValueError("loop_vars must be non-empty")
+    if not (in_static_mode() and any(isinstance(v, Variable)
+                                     for v in loop_vars)):
+        import numpy as np
+
+        def truthy(x):
+            return bool(np.asarray(x.numpy() if hasattr(x, "numpy") else x))
+
+        vals = loop_vars
+        while truthy(cond_fn(*vals)):
+            out = body_fn(*vals)
+            vals = list(out) if isinstance(out, (tuple, list)) else [out]
+        return vals
+
+    c_ops, c_ph, c_outs, c_free = _trace_subblock(cond_fn, loop_vars,
+                                                  "while_cond")
+    if len(c_outs) != 1:
+        raise ValueError("while_loop cond must return exactly one value")
+    _check_scalar_bool(c_outs[0], "while_loop cond")
+    b_ops, b_ph, b_outs, b_free = _trace_subblock(body_fn, loop_vars,
+                                                  "while_body")
+    if len(b_outs) != len(loop_vars):
+        raise ValueError(
+            f"while_loop body returned {len(b_outs)} values for "
+            f"{len(loop_vars)} loop_vars")
+    for i, (lv, bo) in enumerate(zip(loop_vars, b_outs)):
+        la = _aval(lv)
+        if tuple(bo.aval.shape) != tuple(la.shape) \
+                or bo.aval.dtype != la.dtype:
+            raise ValueError(
+                f"while_loop shape invariant violated for loop_var {i}: "
+                f"carry is {tuple(la.shape)}/{la.dtype} but body "
+                f"returns {tuple(bo.aval.shape)}/{bo.aval.dtype} (XLA "
+                "While requires a fixed carry shape — pad or restructure)")
+
+    free_map = dict(c_free)
+    free_map.update(b_free)
+    free_vars = _resolve_free(free_map)
+    free_ids = list(free_map)
+    fn = _WhileFn(SubBlock(c_ops, c_ph, free_ids, [c_outs[0].var_id]),
+                  SubBlock(b_ops, b_ph, free_ids,
+                           [o.var_id for o in b_outs]),
+                  len(loop_vars), maximum_trip_count)
+    from ..core.tape import record_op
+    out = record_op(fn, tuple(loop_vars) + tuple(free_vars), {},
+                    "while_loop")
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference fluid/layers/control_flow.py:2334 cond."""
+    if true_fn is None or false_fn is None:
+        raise ValueError("cond requires both true_fn and false_fn (they "
+                         "must return the same structure)")
+    if not (in_static_mode() and isinstance(pred, Variable)):
+        import numpy as np
+        p = pred.numpy() if hasattr(pred, "numpy") else pred
+        return true_fn() if bool(np.asarray(p)) else false_fn()
+
+    _check_scalar_bool(pred, "cond pred")
+    t_ops, _, t_outs, t_free = _trace_subblock(lambda: true_fn(), [],
+                                               "cond_true")
+    f_ops, _, f_outs, f_free = _trace_subblock(lambda: false_fn(), [],
+                                               "cond_false")
+    if len(t_outs) != len(f_outs):
+        raise ValueError(
+            f"cond branches return different numbers of values: "
+            f"{len(t_outs)} vs {len(f_outs)}")
+    for i, (t, f) in enumerate(zip(t_outs, f_outs)):
+        if tuple(t.aval.shape) != tuple(f.aval.shape) \
+                or t.aval.dtype != f.aval.dtype:
+            raise ValueError(
+                f"cond branch output {i} mismatch: true is "
+                f"{tuple(t.aval.shape)}/{t.aval.dtype}, false is "
+                f"{tuple(f.aval.shape)}/{f.aval.dtype}")
+
+    free_map = dict(t_free)
+    free_map.update(f_free)
+    free_vars = _resolve_free(free_map)
+    free_ids = list(free_map)
+    fn = _CondFn(SubBlock(t_ops, [], free_ids,
+                          [o.var_id for o in t_outs]),
+                 SubBlock(f_ops, [], free_ids,
+                          [o.var_id for o in f_outs]))
+    from ..core.tape import record_op
+    out = record_op(fn, (pred,) + tuple(free_vars), {}, "cond")
+    if isinstance(out, (tuple, list)) and len(out) == 1:
+        return out[0]
+    return out

@@ -360,9 +360,32 @@ class _StaticState(threading.local):
         self.enabled = False
         self.main = Program("main")
         self.startup = Program("startup")
+        self.forced = None  # sub-block tracing override (control_flow.py)
 
 
 _state = _StaticState()
+
+
+def forced_program():
+    """The program a control-flow sub-block trace pins (overrides the
+    per-arg program inference in tape._record_static — an op mixing outer
+    Variables with sub-block placeholders must record into the
+    sub-block)."""
+    return _state.forced
+
+
+class force_program:
+    def __init__(self, program):
+        self.program = program
+
+    def __enter__(self):
+        self._old = _state.forced
+        _state.forced = self.program
+        return self
+
+    def __exit__(self, *exc):
+        _state.forced = self._old
+        return False
 
 
 def in_static_mode() -> bool:

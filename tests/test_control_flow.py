@@ -1,0 +1,211 @@
+"""Static control flow: while_loop / cond (VERDICT r02 item 8; reference
+operators/controlflow/ + fluid/layers/control_flow.py)."""
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, ops
+
+
+def _static():
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    return static
+
+
+def test_while_loop_executor_run():
+    static = _static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4], "float32")
+            i = ops.zeros([], "int32")
+            n = ops.full([], 5, "int32")
+
+            def cond_fn(i, acc):
+                return ops.less_than(i, n)
+
+            def body_fn(i, acc):
+                return i + 1, acc * 2.0
+
+            _, acc = static.nn.while_loop(cond_fn, body_fn, [i, x])
+        exe = static.Executor()
+        xs = np.array([1, 2, 3, 4], "float32")
+        out = exe.run(main, feed={"x": xs}, fetch_list=[acc])[0]
+        np.testing.assert_allclose(out, xs * 32.0)  # doubled 5 times
+    finally:
+        paddle.disable_static()
+
+
+def test_while_loop_shape_invariant_error():
+    static = _static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4], "float32")
+            i = ops.zeros([], "int32")
+            with pytest.raises(ValueError, match="shape invariant"):
+                static.nn.while_loop(
+                    lambda i, a: ops.less_than(i, ops.full([], 3, "int32")),
+                    lambda i, a: (i + 1, ops.concat([a, a])),  # grows!
+                    [i, x])
+    finally:
+        paddle.disable_static()
+
+
+def test_cond_executor_run_and_grad():
+    """cond through Executor.run with a backward section: grads flow
+    through the taken branch."""
+    static = _static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4], "float32")
+            lin = nn.Linear(4, 4)
+            h = lin(x)
+            flag = ops.sum(h) > 0.0
+
+            def t():
+                return ops.sum(h * 2.0)
+
+            def f():
+                return ops.sum(h * -3.0)
+
+            loss = static.nn.cond(flag, t, f)
+            opt = optimizer.SGD(learning_rate=0.1)
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        xs = np.ones(4, "float32")
+        w0 = np.array(static.global_scope().get(lin.weight.scope_name))
+        l0 = exe.run(main, feed={"x": xs}, fetch_list=[loss])[0]
+        w1 = np.array(static.global_scope().get(lin.weight.scope_name))
+        assert not np.allclose(w0, w1)  # gradient actually applied
+        l1 = exe.run(main, feed={"x": xs}, fetch_list=[loss])[0]
+        assert float(l1) != float(l0)
+    finally:
+        paddle.disable_static()
+
+
+def test_cond_branch_mismatch_error():
+    static = _static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4], "float32")
+            p = ops.sum(x) > 0.0
+            with pytest.raises(ValueError, match="mismatch"):
+                static.nn.cond(p, lambda: ops.sum(x),
+                               lambda: ops.reshape(x, [2, 2]))
+    finally:
+        paddle.disable_static()
+
+
+def test_while_loop_bounded_differentiable():
+    """maximum_trip_count lowers to a masked scan, so the loop
+    differentiates: minimize f(w) = (w * 2^k - 8)^2 over scalar w."""
+    static = _static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [1], "float32")
+            lin = nn.Linear(1, 1, bias_attr=False)
+            w = lin(x)  # scalar-ish [1,1]
+            i = ops.zeros([], "int32")
+            three = ops.full([], 3, "int32")
+
+            def cond_fn(i, v):
+                return ops.less_than(i, three)
+
+            def body_fn(i, v):
+                return i + 1, v * 2.0
+
+            _, out = static.nn.while_loop(cond_fn, body_fn, [i, w],
+                                          maximum_trip_count=4)
+            loss = ops.mean((out - 8.0) ** 2)
+            opt = optimizer.SGD(learning_rate=0.005)  # stability: lr < 2/128
+            opt.minimize(loss)
+        exe = static.Executor()
+        exe.run(startup)
+        xs = np.ones((1, 1), "float32")
+        losses = [float(exe.run(main, feed={"x": xs},
+                                fetch_list=[loss])[0])
+                  for _ in range(40)]
+        assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+    finally:
+        paddle.disable_static()
+
+
+def test_beam_search_style_decode():
+    """Greedy iterative decode: repeatedly pick argmax score, accumulate
+    one-hot history — the control-flow shape of beam search (reference
+    dynamic decode, fluid/layers/rnn.py)."""
+    static = _static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            logits = static.data("logits", [6, 8], "float32")  # [steps, V]
+            i = ops.zeros([], "int32")
+            steps = ops.full([], 6, "int32")
+            chosen = ops.zeros([6], "int64")
+            score = ops.zeros([], "float32")
+
+            def cond_fn(i, chosen, score):
+                return ops.less_than(i, steps)
+
+            def body_fn(i, chosen, score):
+                row = ops.gather(logits, ops.reshape(i, [1]))  # [1, 8]
+                tok = ops.reshape(ops.argmax(row, axis=-1), [])
+                s = ops.reshape(ops.max(row), [])
+                onehot = (ops.arange(6, dtype="int32") ==
+                          ops.reshape(i, [1])).astype("int64")
+                return (i + 1,
+                        chosen + onehot * tok.astype("int64"),
+                        score + s)
+
+            _, chosen_f, score_f = static.nn.while_loop(
+                cond_fn, body_fn, [i, chosen, score])
+        exe = static.Executor()
+        L = np.random.RandomState(0).randn(6, 8).astype("float32")
+        toks, sc = exe.run(main, feed={"logits": L},
+                           fetch_list=[chosen_f, score_f])
+        np.testing.assert_array_equal(toks, L.argmax(-1))
+        np.testing.assert_allclose(sc, L.max(-1).sum(), rtol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_while_program_pickles():
+    """Control-flow ops serialize structurally with the Program (the
+    reference pickles sub-blocks inside the ProgramDesc)."""
+    static = _static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            i = ops.zeros([], "int32")
+            lim = ops.full([], 4, "int32")
+            _, y = static.nn.while_loop(
+                lambda i, v: ops.less_than(i, lim),
+                lambda i, v: (i + 1, v + 1.0), [i, x])
+        blob = pickle.dumps(main)
+        main2 = pickle.loads(blob)
+        exe = static.Executor()
+        out = exe.run(main2, feed={"x": np.zeros(2, "float32")},
+                      fetch_list=[y.name])[0]
+        np.testing.assert_allclose(out, [4.0, 4.0])
+    finally:
+        paddle.disable_static()
+
+
+def test_dygraph_fallback():
+    i = paddle.to_tensor(np.int32(0))
+    x = paddle.to_tensor(np.float32(1.0))
+    import paddle_tpu.static as static
+    i_f, x_f = static.nn.while_loop(
+        lambda i, v: i < 3, lambda i, v: (i + 1, v * 2.0), [i, x])
+    assert float(x_f.numpy()) == 8.0
+    out = static.nn.cond(paddle.to_tensor(True), lambda: 1, lambda: 2)
+    assert out == 1
