@@ -111,17 +111,31 @@ class Tensor:
     def __hash__(self):
         return id(self)
 
+    def _concretize(self, caster, what):
+        import jax
+        try:
+            return caster(np.asarray(self._value))
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerBoolConversionError) as e:
+            raise TypeError(
+                f"{what} of a traced Tensor inside a jitted/to_static "
+                "function is data-dependent Python control flow, which "
+                "would bake one branch into the compiled program. Use "
+                "paddle.static.nn.cond / while_loop (or keep the branch "
+                "out of the traced region)") from e
+
     def __bool__(self):
-        return bool(np.asarray(self._value))
+        return self._concretize(bool, "the truth value")
 
     def __float__(self):
-        return float(np.asarray(self._value))
+        return self._concretize(float, "float()")
 
     def __int__(self):
-        return int(np.asarray(self._value))
+        return self._concretize(int, "int()")
 
     def __index__(self):
-        return int(np.asarray(self._value))
+        return self._concretize(int, "index()")
 
     def __array__(self, dtype=None):
         a = np.asarray(self._value)

@@ -135,6 +135,26 @@ class Variable(Tensor):
         # no tape in static mode; symbolic identity is the detachment
         return self
 
+    def _no_concrete(self, what):
+        raise TypeError(
+            f"{what} of symbolic Variable {self.name!r} is undefined at "
+            "graph-build time — Python control flow cannot branch on graph "
+            "values (the reference raises the same way, framework.py "
+            "Variable.__bool__). Use paddle.static.nn.cond / "
+            "paddle.static.nn.while_loop instead")
+
+    def __bool__(self):
+        self._no_concrete("the truth value")
+
+    def __float__(self):
+        self._no_concrete("float()")
+
+    def __int__(self):
+        self._no_concrete("int()")
+
+    def __index__(self):
+        self._no_concrete("index()")
+
     def clone(self):
         from .. import ops
         return ops.assign(self)

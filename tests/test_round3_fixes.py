@@ -38,3 +38,32 @@ def test_sequence_mask_eager_and_jit():
 
     with pytest.raises(ValueError, match="concrete mask width"):
         jax.jit(g)(jnp.asarray([2, 4]))  # dynamic width: loud error
+
+
+def test_to_static_data_dependent_branch_errors():
+    """VERDICT weak #7: tracing must not silently bake `if x.mean() > 0`."""
+    import paddle_tpu.jit as jit
+
+    @jit.to_static
+    def f(x):
+        if (x.mean() > 0):  # data-dependent Python branch
+            return x + 1
+        return x - 1
+
+    x = paddle.to_tensor(np.ones((4,), "float32"))
+    with pytest.raises(TypeError, match="cond"):
+        f(x)
+
+
+def test_static_variable_bool_errors():
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [4], "float32")
+            with pytest.raises(TypeError, match="while_loop"):
+                if x.sum() > 0:  # noqa: F634 — the point is it must raise
+                    pass
+    finally:
+        paddle.disable_static()
