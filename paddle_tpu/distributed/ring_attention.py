@@ -24,9 +24,87 @@ from . import mesh as mesh_mod
 __all__ = ["ring_attention", "ulysses_attention", "sequence_parallel_attention"]
 
 
+def _flash_ring_eligible(q, k):
+    from ..core import flags as _flags
+    if not _flags.flag("FLAGS_use_flash_attention"):
+        return False
+    if jax.default_backend() != "tpu" \
+            and not _flags.flag("FLAGS_pallas_interpret"):
+        return False
+    from ..ops.pallas.flash_attention import supported
+    return supported(tuple(q.shape), tuple(k.shape), tuple(k.shape))
+
+
+def _ring_attention_flash(q, k, v, axis, causal, scale):
+    """Ring attention with the Pallas flash kernel computing each KV
+    block: the kernel returns (out, logsumexp) per block and blocks merge
+    exactly in log-space. Causality per ring step resolves to one of three
+    static cases — full (kv from an earlier rank), diagonal (own kv,
+    causal mask), skip (future kv) — selected by lax.cond on the traced
+    source rank, so each device compiles one program with an HLO
+    conditional and never materializes masked work."""
+    import jax.numpy as jnp
+    from ..ops.pallas.flash_attention import flash_attention
+
+    n = mesh_mod.mesh_axis_size(axis)
+    my = lax.axis_index(axis)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, h, s, d = q.shape
+    NEG = -1e9
+
+    def merge(carry, o_i, lse_i):
+        num, m, l = carry                       # [b,h,s,d] f32, [b,h,s] x2
+        m_new = jnp.maximum(m, lse_i)
+        sc_old = jnp.exp(m - m_new)
+        sc_new = jnp.exp(lse_i - m_new)
+        num = num * sc_old[..., None] + o_i.astype(jnp.float32) \
+            * sc_new[..., None]
+        return num, m_new, l * sc_old + sc_new
+
+    def step(i, carry):
+        k_cur, v_cur, num, m, l = carry
+        src = (my - i) % n
+
+        def full(_):
+            return flash_attention(q, k_cur, v_cur, causal=False,
+                                   scale=scale, return_lse=True)
+
+        def diag(_):
+            return flash_attention(q, k_cur, v_cur, causal=True,
+                                   scale=scale, return_lse=True)
+
+        def skip(_):
+            return (jnp.zeros((b, h, s, d), q.dtype),
+                    jnp.full((b, h, s), NEG, jnp.float32))
+
+        if causal:
+            o_i, lse_i = lax.cond(
+                src < my, full,
+                lambda op: lax.cond(src == my, diag, skip, op), None)
+        else:
+            o_i, lse_i = full(None)
+        num, m, l = merge((num, m, l), o_i, lse_i)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        return (lax.ppermute(k_cur, axis, perm),
+                lax.ppermute(v_cur, axis, perm), num, m, l)
+
+    num0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m0 = jnp.full((b, h, s), NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    carry = (k, v, num0, m0, l0)
+    for i in range(n):  # unrolled: ppermute of i+1 overlaps compute of i
+        carry = step(i, carry)
+    _, _, num, m, l = carry
+    out = num / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
 def _ring_attention_raw(q, k, v, axis, causal, scale):
     """q,k,v: [batch, heads, seq_local, dim] per device; seq sharded on
     `axis`. Online-softmax accumulation over ring steps."""
+    if _flash_ring_eligible(q, k):
+        return _ring_attention_flash(q, k, v, axis, causal, scale)
     n = mesh_mod.mesh_axis_size(axis)
     my = lax.axis_index(axis)
     s_local = q.shape[2]
@@ -112,6 +190,11 @@ def _ulysses_raw(q, k, v, axis, causal, scale):
 
     qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
     sc = scale if scale is not None else q.shape[-1] ** -0.5
+    if _flash_ring_eligible(qh, kh):
+        # full-sequence local attention on the MXU via the flash kernel
+        from ..ops.pallas.flash_attention import flash_attention
+        out = flash_attention(qh, kh, vh, causal=causal, scale=sc)
+        return head_to_seq(out)
     logits = jnp.einsum("bhqd,bhkd->bhqk", qh * sc, kh,
                         preferred_element_type=jnp.float32)
     if causal:

@@ -357,6 +357,29 @@ def _flash_bwd(scale, causal, heads, bq, bk, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_with_lse(q, k, v, bias, scale, causal, heads, bq, bk):
+    return _fwd(q, k, v, bias, scale, causal, heads, bq, bk)
+
+
+def _flash_with_lse_fwd(q, k, v, bias, scale, causal, heads, bq, bk):
+    out, lse = _fwd(q, k, v, bias, scale, causal, heads, bq, bk)
+    return (out, lse), (q, k, v, bias, out, lse)
+
+
+def _flash_with_lse_bwd(scale, causal, heads, bq, bk, res, g):
+    q, k, v, bias, out, lse = res
+    g_out, _g_lse = g  # lse is a statistic; cotangents through it are
+    # not propagated (ring merges treat it as weighting data)
+    dq, dk, dv = _bwd(q, k, v, bias, out, lse, g_out, scale, causal, heads,
+                      bq, bk)
+    dbias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, dbias
+
+
+_flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
+
+
 def supported(q_shape, k_shape, v_shape, mask_shape=None) -> bool:
     """Static predicate: can flash_attention handle these shapes? Anything
     rejected here must take the jnp fallback (_sdpa), which handles general
@@ -376,12 +399,16 @@ def supported(q_shape, k_shape, v_shape, mask_shape=None) -> bool:
     return True
 
 
-def flash_attention(q, k, v, bias=None, causal=False, scale=None):
+def flash_attention(q, k, v, bias=None, causal=False, scale=None,
+                    return_lse=False):
     """Online-softmax attention, O(s) memory.
 
     q: [b, h, s_q, d]; k, v: [b, h, s_k, d]; bias: optional additive mask
     [b, s_k] (f32; use NEG_INF-scale values for masked keys — treated as
-    non-differentiable data). Returns [b, h, s_q, d] in q's dtype.
+    non-differentiable data). Returns [b, h, s_q, d] in q's dtype; with
+    return_lse=True also the per-row logsumexp [b, h, s_q] (f32), which
+    lets callers merge partial-attention blocks exactly — the ring
+    attention merge (distributed/ring_attention.py).
     """
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -400,5 +427,9 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None):
     vf = v.reshape(b * h, sk, d)
     if bias is not None:
         bias = jax.lax.stop_gradient(bias.astype(jnp.float32))
+    if return_lse:
+        out, lse = _flash_with_lse(qf, kf, vf, bias, float(scale),
+                                   bool(causal), h, bq, bk)
+        return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
     out = _flash(qf, kf, vf, bias, float(scale), bool(causal), h, bq, bk)
     return out.reshape(b, h, sq, d)

@@ -147,6 +147,51 @@ def test_flash_fallbacks():
         paddle.set_flags({"FLAGS_flash_attention_interpret": False})
 
 
+def test_ring_attention_flash_path_matches():
+    """Ring attention over the sp axis with the flash kernel per block
+    must equal full single-device attention (causal and not)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.ring_attention import _ring_attention_raw
+
+    mesh = mesh_mod.init_mesh({"sp": 8})
+    rng = np.random.RandomState(0)
+    b, h, s, d = 1, 2, 64, 16   # s_local = 8 per device
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    spec = P(None, None, "sp", None)
+
+    for causal in (False, True):
+        ref = _ref(q, k, v, causal=causal)
+        paddle.set_flags({"FLAGS_pallas_interpret": True})
+        try:
+            out = jax.shard_map(
+                lambda ql, kl, vl: _ring_attention_raw(
+                    ql, kl, vl, "sp", causal, None),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False)(q, k, v)
+        finally:
+            paddle.set_flags({"FLAGS_pallas_interpret": False})
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5)
+    mesh_mod.init_mesh({"dp": 8})
+
+
+def test_flash_return_lse():
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 1, 32, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 1, 32, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 1, 32, 8), jnp.float32)
+    out, lse = flash_attention(q, k, v, return_lse=True)
+    # lse must equal logsumexp of the scaled logits
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (8 ** -0.5)
+    want = jax.scipy.special.logsumexp(s, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want),
+                               atol=2e-5)
+
+
 def test_flash_bf16():
     rng = np.random.RandomState(2)
     q = jnp.asarray(rng.randn(1, 2, 64, 32), jnp.bfloat16)
