@@ -1,0 +1,48 @@
+"""Global runtime counters.
+
+Analog of the reference monitor (reference platform/monitor.h:77
+StatRegistry singleton, STAT_ADD :130 — process-wide named counters like
+GPU memory stats, exported to Python through
+pybind/global_value_getter_setter.cc). Same shape here: cheap named
+int/float counters the runtime bumps at interesting points (program
+lowerings, train steps, dataloader batches), snapshotted for dashboards
+and tests.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+__all__ = ["stat_add", "stat_set", "stat_get", "stats", "reset"]
+
+_lock = threading.Lock()
+_stats = defaultdict(float)
+
+
+def stat_add(name: str, value=1):
+    """STAT_ADD analog (reference monitor.h:130)."""
+    with _lock:
+        _stats[name] += value
+
+
+def stat_set(name: str, value):
+    with _lock:
+        _stats[name] = value
+
+
+def stat_get(name: str):
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def stats() -> dict:
+    with _lock:
+        return dict(_stats)
+
+
+def reset(name: str = None):
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)

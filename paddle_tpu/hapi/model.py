@@ -299,6 +299,8 @@ class _CompiledEngine:
             scale_state = scaler.scale_state() if scaler is not None else {}
             opt._step_count += 1
             from .. import profiler as _prof
+            from ..core import monitor as _monitor
+            _monitor.stat_add("hapi/train_steps")
             with _prof.RecordEvent("hapi/train_step"):
                 lval, outs, new_bufs, new_params, new_slots, scale_state = \
                     self._train_fn(

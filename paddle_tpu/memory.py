@@ -1,0 +1,115 @@
+"""Device memory introspection.
+
+Analog of the reference memory subsystem's *observable* surface
+(reference paddle/fluid/memory/ allocator facade ~7k LoC: stats in
+allocation/allocator_facade.cc, `memory::StatGetCurrentValue`, and the
+paddle.device.cuda.memory_allocated/max_memory_allocated APIs).
+
+Design delta: XLA/PJRT owns allocation (BFC-style arena per device), so
+the reference's strategy zoo (naive_best_fit / auto_growth / retry)
+collapses into PJRT; what remains OURS is instrumentation — per-device
+byte counters from the PJRT allocator, live-buffer accounting from the
+runtime, and a human-readable summary. On backends whose PJRT plugin
+reports no stats (CPU), live-array accounting is the fallback.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+__all__ = ["memory_allocated", "max_memory_allocated", "memory_reserved",
+           "stats", "live_bytes", "live_tensor_count", "summary",
+           "empty_cache"]
+
+
+def _device(device=None):
+    import jax
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    return device
+
+
+def _stats(device):
+    st = device.memory_stats() if hasattr(device, "memory_stats") else None
+    return st or {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (reference
+    memory_allocated; PJRT `bytes_in_use`)."""
+    d = _device(device)
+    st = _stats(d)
+    if "bytes_in_use" in st:
+        return int(st["bytes_in_use"])
+    return live_bytes(d)
+
+
+def max_memory_allocated(device=None) -> int:
+    """High-water mark (PJRT `peak_bytes_in_use`); 0 where the plugin
+    doesn't track peaks (CPU)."""
+    return int(_stats(_device(device)).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    """Arena size reserved from the system (`bytes_limit`/`bytes_reserved`)."""
+    st = _stats(_device(device))
+    return int(st.get("bytes_reserved", st.get("bytes_limit", 0)))
+
+
+def stats(device=None) -> dict:
+    """Raw PJRT allocator stats dict (may be empty on CPU)."""
+    return dict(_stats(_device(device)))
+
+
+def live_bytes(device=None) -> int:
+    """Sum of live jax array bytes on the device (runtime accounting,
+    backend-independent)."""
+    import jax
+    d = _device(device)
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            if d in a.devices():
+                total += a.nbytes // len(a.devices())
+        except Exception:
+            pass
+    return int(total)
+
+
+def live_tensor_count() -> int:
+    import jax
+    return len(jax.live_arrays())
+
+
+def empty_cache():
+    """Parity no-op: XLA's arena is not user-flushable; kept so reference
+    scripts run unchanged (the reference's Release() equivalent)."""
+
+
+def summary(device=None) -> str:
+    """Human-readable report: allocator stats + live buffers by dtype."""
+    import jax
+    d = _device(device)
+    st = _stats(d)
+    lines = [f"memory summary for {d}"]
+    if st:
+        for k in sorted(st):
+            lines.append(f"  {k:<28}{st[k]}")
+    by_dtype = defaultdict(lambda: [0, 0])
+    for a in jax.live_arrays():
+        try:
+            if d in a.devices():
+                e = by_dtype[str(a.dtype)]
+                e[0] += 1
+                e[1] += a.nbytes // len(a.devices())
+        except Exception:
+            pass
+    lines.append(f"  live arrays: {sum(v[0] for v in by_dtype.values())}"
+                 f" ({sum(v[1] for v in by_dtype.values()) / 1e6:.2f} MB)")
+    for dt, (n, nbytes) in sorted(by_dtype.items(),
+                                  key=lambda kv: -kv[1][1]):
+        lines.append(f"    {dt:<12}{n:>6} arrays {nbytes / 1e6:>10.2f} MB")
+    return "\n".join(lines)

@@ -112,10 +112,12 @@ class Executor:
         entry = self._cache.get(key)
         if entry is None:
             from .. import profiler as _prof
+            from ..core import monitor as _monitor
             with _prof.RecordEvent("executor/lower_program"):
                 entry = self._compile(program, sorted(feed_vals), fetch_ids,
                                       data_parallel)
             self._cache[key] = entry
+            _monitor.stat_add("executor/lowerings")
         step, persist_names, opt = entry
 
         scope_vals = {n: scope.get(n) for n in persist_names}
@@ -129,7 +131,9 @@ class Executor:
             t = jnp.asarray(opt._step_count, jnp.int32)
 
         from ..core import rng as _rng
+        from ..core import monitor as _monitor
         from .. import profiler as _prof
+        _monitor.stat_add("executor/runs")
         with _prof.RecordEvent("executor/run_step"):
             fetches, new_scope, new_slots = step(
                 tuple(feed_vals[n] for n in sorted(feed_vals)), scope_vals,

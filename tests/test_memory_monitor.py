@@ -1,0 +1,59 @@
+"""Memory introspection (reference memory/ stats surface) and the
+monitor StatRegistry (reference platform/monitor.h:77)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import memory
+from paddle_tpu.core import monitor
+
+
+def test_live_accounting_tracks_allocations():
+    base = memory.memory_allocated()
+    big = paddle.to_tensor(np.zeros((256, 1024), "float32"))  # 1 MB
+    now = memory.memory_allocated()
+    assert now >= base + 1_000_000, (base, now)
+    s = memory.summary()
+    assert "live arrays" in s and "float32" in s
+    del big
+    memory.empty_cache()  # parity no-op, must not raise
+
+
+def test_stats_surface():
+    st = memory.stats()
+    assert isinstance(st, dict)  # may be empty on CPU PJRT
+    assert memory.max_memory_allocated() >= 0
+    assert memory.memory_reserved() >= 0
+    keep = paddle.to_tensor(np.ones((4,), "float32"))
+    assert memory.live_tensor_count() >= 1
+    del keep
+
+
+def test_monitor_stat_registry():
+    monitor.reset()
+    monitor.stat_add("unit/x")
+    monitor.stat_add("unit/x", 4)
+    monitor.stat_set("unit/y", 2.5)
+    assert monitor.stat_get("unit/x") == 5
+    assert monitor.stats()["unit/y"] == 2.5
+    monitor.reset("unit/x")
+    assert monitor.stat_get("unit/x") == 0
+
+
+def test_runtime_counters_bump():
+    import paddle_tpu.static as static
+    from paddle_tpu import ops
+    monitor.reset()
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            y = ops.sum(x)
+        exe = static.Executor()
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones(2, "float32")},
+                    fetch_list=[y])
+    finally:
+        paddle.disable_static()
+    assert monitor.stat_get("executor/lowerings") == 1  # cached after first
+    assert monitor.stat_get("executor/runs") == 3
