@@ -1,0 +1,113 @@
+"""RaggedTensor — the LoDTensor analog.
+
+The reference threads ragged structure through LoDTensor (reference
+framework/lod_tensor.h: a dense buffer + level-of-detail offset table) and
+~40 sequence_* ops that walk the offsets. XLA wants static shapes, so the
+TPU-native design (SURVEY hard part 1) maps ragged data to the two forms
+compilers love:
+
+- **packed**: values [total, ...] + row_splits [n+1] (= the reference's
+  level-0 LoD offsets verbatim) — segment-reduction ops consume this via
+  segment ids;
+- **padded**: dense [n, maxlen, ...] + lengths [n] — attention/matmul ops
+  consume this with masks.
+
+`RaggedTensor` holds the packed form, converts losslessly to/from padded,
+and exposes the reference's recursive_sequence_lengths/lod accessors.
+Sequence ops over it live in ops/sequence.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["RaggedTensor"]
+
+
+class RaggedTensor:
+    __slots__ = ("values", "row_splits")
+
+    def __init__(self, values, row_splits):
+        import jax.numpy as jnp
+        self.values = values if hasattr(values, "dtype") \
+            else jnp.asarray(values)
+        self.row_splits = jnp.asarray(row_splits, jnp.int32)
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_rows(rows):
+        """From a list of per-sequence arrays."""
+        import jax.numpy as jnp
+        lengths = [int(np.shape(r)[0]) for r in rows]
+        splits = np.zeros(len(rows) + 1, np.int32)
+        np.cumsum(lengths, out=splits[1:])
+        values = jnp.concatenate([jnp.asarray(r) for r in rows], axis=0) \
+            if rows else jnp.zeros((0,), jnp.float32)
+        return RaggedTensor(values, splits)
+
+    @staticmethod
+    def from_padded(padded, lengths):
+        """Inverse of to_padded: gather the valid prefix of every row.
+        Eager-only (output length is data-dependent)."""
+        import jax.numpy as jnp
+        lengths = np.asarray(lengths, np.int64)
+        rows = [np.asarray(padded[i, :int(n)]) for i, n in enumerate(lengths)]
+        out = RaggedTensor.from_rows([jnp.asarray(r) for r in rows])
+        return out
+
+    # -- reference LoD accessors -------------------------------------------
+    @property
+    def lod(self):
+        """Level-0 offsets, the reference LoD table (lod_tensor.h)."""
+        return [list(np.asarray(self.row_splits))]
+
+    def recursive_sequence_lengths(self):
+        s = np.asarray(self.row_splits)
+        return [list((s[1:] - s[:-1]).astype(np.int64))]
+
+    @property
+    def lengths(self):
+        return self.row_splits[1:] - self.row_splits[:-1]
+
+    @property
+    def nrows(self):
+        return int(self.row_splits.shape[0]) - 1
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    # -- segment ids: what segment-reduction kernels consume ---------------
+    def segment_ids(self):
+        """int32 [total]: row index of every value (the ragged->segment-ids
+        mapping XLA ops reduce over)."""
+        import jax.numpy as jnp
+        total = self.values.shape[0]
+        return jnp.searchsorted(self.row_splits[1:],
+                                jnp.arange(total, dtype=jnp.int32),
+                                side="right").astype(jnp.int32)
+
+    # -- padded <-> packed --------------------------------------------------
+    def to_padded(self, maxlen=None, pad_value=0):
+        """Dense [n, maxlen, ...] + the mask implied by self.lengths.
+        maxlen must be static under jit (defaults to max length, eager)."""
+        import jax.numpy as jnp
+        lens = self.lengths
+        if maxlen is None:
+            maxlen = int(np.asarray(lens).max()) if self.nrows else 0
+        n = self.nrows
+        tail = self.values.shape[1:]
+        idx = self.row_splits[:-1, None] + jnp.arange(maxlen)[None, :]
+        valid = jnp.arange(maxlen)[None, :] < lens[:, None]
+        idx = jnp.clip(idx, 0, max(self.values.shape[0] - 1, 0))
+        out = self.values[idx.reshape(-1)].reshape((n, maxlen) + tail)
+        mask = valid.reshape((n, maxlen) + (1,) * len(tail))
+        return jnp.where(mask, out, jnp.asarray(pad_value, out.dtype))
+
+    def to_list(self):
+        s = np.asarray(self.row_splits)
+        v = np.asarray(self.values)
+        return [v[s[i]:s[i + 1]] for i in range(self.nrows)]
+
+    def __repr__(self):
+        return (f"RaggedTensor(nrows={self.nrows}, "
+                f"values={tuple(self.values.shape)}, dtype={self.dtype})")

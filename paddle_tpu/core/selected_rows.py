@@ -1,0 +1,94 @@
+"""SelectedRows — sparse row-slice gradients.
+
+Analog of reference framework/selected_rows.h: a {rows, value, height}
+triple standing in for a mostly-zero dense tensor, produced by embedding
+lookups' backward so huge-vocab tables never materialize dense gradients
+(reference operators/lookup_table_v2_op.cc grad kernel emits SelectedRows;
+optimizers like sgd_op.cc / adam_op.cc lazy_mode consume them row-wise).
+
+TPU-native scoping: sparse grads are an EAGER-mode feature. Inside jitted
+steps gradients are dense by construction (XLA fuses gather-transpose
+scatter-adds efficiently, and dynamic row counts don't trace); in eager
+mode — where the reference's PS/recsys workflows live — the tape's
+embedding backward emits SelectedRows, `+` accumulates them without
+densifying, and optimizers apply row-wise updates (SGD, Adam lazy_mode).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    """rows: int array [n]; values: [n, ...] row payloads;
+    dense_shape: the full tensor shape it abbreviates."""
+
+    __slots__ = ("rows", "values", "dense_shape")
+
+    def __init__(self, rows, values, dense_shape):
+        import jax.numpy as jnp
+        self.rows = jnp.asarray(rows).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.dense_shape = tuple(dense_shape)
+        if self.values.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"rows ({self.rows.shape[0]}) and values "
+                f"({self.values.shape[0]}) disagree")
+
+    # reference SelectedRows::height()
+    @property
+    def height(self):
+        return self.dense_shape[0]
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return self.dense_shape
+
+    @property
+    def nbytes(self):
+        return self.values.nbytes + self.rows.nbytes
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.dense_shape != self.dense_shape:
+                raise ValueError("SelectedRows shape mismatch")
+            import jax.numpy as jnp
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]),
+                self.dense_shape)
+        if other is None:
+            return self
+        # sparse + dense -> dense (mixed consumers densify)
+        return self.to_dense() + other
+
+    __radd__ = __add__
+
+    def coalesce(self):
+        """Merge duplicate rows (sum), sorted — the reference's
+        MergeAdd functor (operators/math/selected_rows_functor.cc).
+        Eager-only: row count is data-dependent."""
+        import jax
+        import jax.numpy as jnp
+        rows = np.asarray(self.rows)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        summed = jax.ops.segment_sum(self.values, jnp.asarray(inv),
+                                     num_segments=len(uniq))
+        return SelectedRows(jnp.asarray(uniq), summed, self.dense_shape)
+
+    def to_dense(self):
+        import jax.numpy as jnp
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={self.rows.shape[0]}, "
+                f"dense_shape={self.dense_shape}, dtype={self.dtype})")

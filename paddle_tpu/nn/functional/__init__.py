@@ -42,7 +42,21 @@ def linear(x, weight, bias=None, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """Note the paddle-2.0 argument order (ids first)."""
+    """Note the paddle-2.0 argument order (ids first).
+
+    sparse=True in eager mode emits SelectedRows gradients for the table
+    (reference lookup_table_v2 grad -> framework/selected_rows.h); under
+    jit/static the dense gather's scatter-add transpose is already the
+    efficient XLA form, so sparse is a no-op there."""
+    if sparse:
+        import jax
+        from ...core import tape as _tape
+        if (_tape.is_grad_enabled() and isinstance(weight, Tensor)
+                and not weight.stop_gradient
+                and weight._value is not None
+                and not isinstance(weight._value, jax.core.Tracer)):
+            from ...ops.norm_ops import _sparse_embedding
+            return _sparse_embedding(weight, x, padding_idx)
     return _embedding_op(weight, x, padding_idx=padding_idx, sparse=sparse)
 
 

@@ -15,5 +15,6 @@ from .activation import *    # noqa: F401,F403
 from .conv import *          # noqa: F401,F403
 from .norm_ops import *      # noqa: F401,F403
 from .loss import *          # noqa: F401,F403
+from .sequence import *      # noqa: F401,F403
 
 from . import _bind  # attaches Tensor operators/methods  # noqa: F401,E402

@@ -149,9 +149,10 @@ def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None):
 
 @defop
 def embedding(weight, ids, padding_idx=None, sparse=False):
-    # reference: operators/lookup_table_v2_op.cc. sparse=True maps to the
-    # same dense gather on TPU: SelectedRows grads have no XLA analog, the
-    # gather's scatter-add transpose is already the efficient form.
+    # reference: operators/lookup_table_v2_op.cc. In jitted steps the dense
+    # gather is the right form (XLA fuses the scatter-add transpose); in
+    # EAGER mode sparse=True emits SelectedRows grads so huge-vocab tables
+    # never materialize dense gradients (core/selected_rows.py).
     out = jnp.take(weight, ids, axis=0)
     if padding_idx is not None:
         if padding_idx < 0:  # paddle normalizes negative indices
@@ -159,6 +160,36 @@ def embedding(weight, ids, padding_idx=None, sparse=False):
         mask = (ids != padding_idx)[..., None].astype(out.dtype)
         out = out * mask
     return out
+
+
+def _sparse_embedding(weight_t, ids_t, padding_idx):
+    """Eager-only sparse-grad embedding: custom tape Node whose backward
+    emits SelectedRows for the table (the lookup_table_v2 grad kernel's
+    SelectedRows output, made a tape citizen)."""
+    from ..core.selected_rows import SelectedRows
+    from ..core.tape import Node, _wrap_outputs
+    from ..core.tensor import Tensor
+
+    weight = weight_t._value
+    ids = ids_t._value if isinstance(ids_t, Tensor) else jnp.asarray(ids_t)
+    pidx = padding_idx
+    if pidx is not None and pidx < 0:
+        pidx = weight.shape[0] + pidx
+    out = jnp.take(weight, ids, axis=0)
+    if pidx is not None:
+        out = out * (ids != pidx)[..., None].astype(out.dtype)
+
+    def vjp_fn(g):
+        rows = ids.reshape(-1)
+        vals = g.reshape(-1, weight.shape[-1]).astype(weight.dtype)
+        if pidx is not None:
+            keep = (rows != pidx)[:, None].astype(vals.dtype)
+            vals = vals * keep
+        return (SelectedRows(rows, vals, weight.shape),)
+
+    node = Node(vjp_fn, [weight_t], [(tuple(out.shape), out.dtype)],
+                "embedding_sparse_grad", False)
+    return _wrap_outputs(out, node=node, stop_gradient=False)
 
 
 @defop

@@ -1,0 +1,142 @@
+"""Sequence ops — the reference's sequence_* family on TPU-native forms.
+
+Reference: paddle/fluid/operators/sequence_ops/ (~20 ops walking LoD
+offsets: sequence_pool_op.cc, sequence_expand_op.cc, sequence_concat,
+sequence_reverse, sequence_softmax, sequence_slice ...). Design delta
+(SURVEY hard part 1): instead of per-sequence loops over offsets, every op
+is a segment-reduction or mask over the packed (values, row_splits) form —
+jax.ops.segment_* map straight onto efficient XLA scatter/reduce-window —
+with RaggedTensor (core/ragged.py) carrying the structure.
+
+All ops accept a RaggedTensor or a (values, row_splits) pair.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.ragged import RaggedTensor
+
+__all__ = ["sequence_pool", "sequence_softmax", "sequence_expand",
+           "sequence_concat", "sequence_reverse", "sequence_first_step",
+           "sequence_last_step", "sequence_slice", "sequence_pad",
+           "sequence_unpad"]
+
+
+def _as_ragged(x, row_splits=None):
+    if isinstance(x, RaggedTensor):
+        return x
+    from ..core.tensor import Tensor
+    if isinstance(x, Tensor):
+        x = x._value
+    return RaggedTensor(x, row_splits)
+
+
+def sequence_pool(x, pool_type="sum", row_splits=None):
+    """reference sequence_pool_op.cc: {sum, average, max, min, sqrt, first,
+    last} over each sequence. Returns [nrows, ...]."""
+    r = _as_ragged(x, row_splits)
+    sid = r.segment_ids()
+    n = r.nrows
+    pt = pool_type.lower()
+    if pt == "sum":
+        return jax.ops.segment_sum(r.values, sid, num_segments=n)
+    if pt in ("average", "mean"):
+        s = jax.ops.segment_sum(r.values, sid, num_segments=n)
+        cnt = jnp.maximum(r.lengths, 1).astype(s.dtype)
+        return s / cnt.reshape((n,) + (1,) * (s.ndim - 1))
+    if pt == "sqrt":
+        s = jax.ops.segment_sum(r.values, sid, num_segments=n)
+        cnt = jnp.maximum(r.lengths, 1).astype(s.dtype)
+        return s / jnp.sqrt(cnt).reshape((n,) + (1,) * (s.ndim - 1))
+    if pt == "max":
+        return jax.ops.segment_max(r.values, sid, num_segments=n)
+    if pt == "min":
+        return jax.ops.segment_min(r.values, sid, num_segments=n)
+    if pt == "first":
+        return sequence_first_step(r)
+    if pt == "last":
+        return sequence_last_step(r)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(x, row_splits=None):
+    r = _as_ragged(x, row_splits)
+    return r.values[r.row_splits[:-1]]
+
+
+def sequence_last_step(x, row_splits=None):
+    r = _as_ragged(x, row_splits)
+    return r.values[jnp.maximum(r.row_splits[1:] - 1, 0)]
+
+
+def sequence_softmax(x, row_splits=None):
+    """reference sequence_softmax_op.cc: softmax within each sequence."""
+    r = _as_ragged(x, row_splits)
+    sid = r.segment_ids()
+    n = r.nrows
+    mx = jax.ops.segment_max(r.values, sid, num_segments=n)
+    e = jnp.exp(r.values - mx[sid])
+    denom = jax.ops.segment_sum(e, sid, num_segments=n)
+    return RaggedTensor(e / denom[sid], r.row_splits)
+
+
+def sequence_expand(x, ref, row_splits=None):
+    """reference sequence_expand_op.cc: repeat row i of `x` to the length
+    of sequence i in `ref` (eager: output size is data-dependent)."""
+    r = _as_ragged(ref) if isinstance(ref, RaggedTensor) \
+        else _as_ragged(ref, row_splits)
+    from ..core.tensor import Tensor
+    vals = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    reps = np.asarray(r.lengths)
+    idx = np.repeat(np.arange(len(reps)), reps)
+    return RaggedTensor(vals[jnp.asarray(idx)], r.row_splits)
+
+
+def sequence_concat(xs):
+    """reference sequence_concat_op.cc: concat per-sequence (not global)."""
+    rs = [x if isinstance(x, RaggedTensor) else _as_ragged(x) for x in xs]
+    n = rs[0].nrows
+    if any(r.nrows != n for r in rs):
+        raise ValueError("sequence_concat needs equal sequence counts")
+    rows = []
+    lists = [r.to_list() for r in rs]
+    for i in range(n):
+        rows.append(np.concatenate([ls[i] for ls in lists], axis=0))
+    return RaggedTensor.from_rows([jnp.asarray(r) for r in rows])
+
+
+def sequence_reverse(x, row_splits=None):
+    """reference sequence_reverse_op.h: reverse within each sequence."""
+    r = _as_ragged(x, row_splits)
+    starts = r.row_splits[:-1]
+    ends = r.row_splits[1:]
+    sid = r.segment_ids()
+    pos = jnp.arange(r.values.shape[0], dtype=jnp.int32)
+    mirrored = starts[sid] + (ends[sid] - 1 - pos)
+    return RaggedTensor(r.values[mirrored], r.row_splits)
+
+
+def sequence_slice(x, offset, length, row_splits=None):
+    """reference sequence_slice_op.h: per-sequence [offset, offset+length)."""
+    r = _as_ragged(x, row_splits)
+    offset = np.asarray(offset).reshape(-1)
+    length = np.asarray(length).reshape(-1)
+    rows = r.to_list()
+    out = [rows[i][int(offset[i]):int(offset[i]) + int(length[i])]
+           for i in range(r.nrows)]
+    return RaggedTensor.from_rows([jnp.asarray(o) for o in out])
+
+
+def sequence_pad(x, pad_value=0, maxlen=None, row_splits=None):
+    """reference sequence_pad_op.cc: packed -> (padded, lengths)."""
+    r = _as_ragged(x, row_splits)
+    return r.to_padded(maxlen=maxlen, pad_value=pad_value), r.lengths
+
+
+def sequence_unpad(x, lengths):
+    """reference sequence_unpad_op.cc: (padded, lengths) -> packed."""
+    from ..core.tensor import Tensor
+    vals = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return RaggedTensor.from_padded(vals, lengths)

@@ -182,10 +182,36 @@ class Optimizer:
             self._step_fn_sig = sig
         return self._step_fn
 
+    def _sparse_update(self, name, param, sr, lr):
+        """Row-wise update for a SelectedRows grad; optimizers that can't
+        (or shouldn't — adam without lazy_mode decays ALL moments) return
+        False to take the densify path. Reference: sgd_op.cc /
+        adam_op.cc SelectedRows kernels."""
+        return False
+
     @_tape.no_grad()
     def step(self):
+        from ..core.selected_rows import SelectedRows
+        from ..core.tensor import Tensor as _T
         named = self._collect()
         if not named:
+            return
+        # sparse grads (eager embedding sparse=True): row-wise path where
+        # the optimizer supports it, densify otherwise
+        lr_now = jnp.asarray(self.get_lr(), jnp.float32)
+        for k in list(named):
+            p = named[k]
+            if isinstance(p.grad._value, SelectedRows):
+                self._ensure_slots({k: p._value})
+                if self._sparse_update(k, p, p.grad._value.coalesce(),
+                                       lr_now):
+                    p.grad = None
+                    del named[k]
+                else:
+                    p.grad = _T(p.grad._value.to_dense(),
+                                stop_gradient=True, _internal=True)
+        if not named:
+            self._step_count += 1
             return
         params = {k: p._value for k, p in named.items()}
         grads = {k: p.grad._value for k, p in named.items()}
@@ -264,6 +290,13 @@ class SGD(Optimizer):
     def _rule(self, p, g, slots, lr, t):
         return p - lr.astype(p.dtype) * g, {}
 
+    def _sparse_update(self, name, param, sr, lr):
+        # sgd_op.cc's SelectedRows kernel: touch only the looked-up rows
+        param._value = param._value.at[sr.rows].add(
+            (-lr * sr.values.astype(jnp.float32)).astype(param._value.dtype))
+        param._node = None
+        return True
+
 
 class Momentum(Optimizer):
     """reference: operators/optimizers/momentum_op.cc (use_nesterov attr)."""
@@ -302,9 +335,33 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def _init_slots_for(self, name, v):
         return {"moment1": self._slot_like(v), "moment2": self._slot_like(v)}
+
+    def _sparse_update(self, name, param, sr, lr):
+        # adam_op.cc lazy_mode: moments update only for touched rows (a
+        # non-lazy adam must decay every row's moments -> densify path)
+        if not self._lazy_mode:
+            return False
+        slots = self._slots[name]
+        m, v = slots["moment1"], slots["moment2"]
+        rows = sr.rows
+        g = sr.values.astype(jnp.float32)
+        m_r = self._beta1 * m[rows] + (1 - self._beta1) * g
+        v_r = self._beta2 * v[rows] + (1 - self._beta2) * jnp.square(g)
+        t = jnp.float32(self._step_count + 1)
+        bc1 = 1 - jnp.power(jnp.float32(self._beta1), t)
+        bc2 = 1 - jnp.power(jnp.float32(self._beta2), t)
+        upd = (lr * jnp.sqrt(bc2) / bc1) * m_r / (jnp.sqrt(v_r)
+                                                  + self._epsilon)
+        slots["moment1"] = m.at[rows].set(m_r)
+        slots["moment2"] = v.at[rows].set(v_r)
+        param._value = param._value.at[rows].add(
+            (-upd).astype(param._value.dtype))
+        param._node = None
+        return True
 
     def _rule(self, p, g, slots, lr, t):
         # moment math in f32 regardless of param dtype (bf16-safe)

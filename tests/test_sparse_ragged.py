@@ -1,0 +1,145 @@
+"""SelectedRows sparse gradients + RaggedTensor/sequence ops
+(SURVEY hard part 1; reference framework/selected_rows.h,
+framework/lod_tensor.h, operators/sequence_ops/)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops, optimizer
+from paddle_tpu.core.ragged import RaggedTensor
+from paddle_tpu.core.selected_rows import SelectedRows
+
+
+# --------------------------- SelectedRows ---------------------------------
+
+def test_sparse_embedding_grad_is_selected_rows():
+    paddle.seed(0)
+    emb = nn.Embedding(100, 8, sparse=True)
+    ids = paddle.to_tensor(np.array([[1, 3], [3, 7]], "int64"))
+    out = emb(ids)
+    out.sum().backward()
+    g = emb.weight.grad._value
+    assert isinstance(g, SelectedRows)
+    assert g.dense_shape == (100, 8)
+    assert sorted(np.asarray(g.rows).tolist()) == [1, 3, 3, 7]
+    # densified grad must equal the dense-path grad
+    emb2 = nn.Embedding(100, 8, sparse=False)
+    emb2.weight.set_value(np.asarray(emb.weight._value))
+    out2 = emb2(ids)
+    out2.sum().backward()
+    np.testing.assert_allclose(np.asarray(g.to_dense()),
+                               np.asarray(emb2.weight.grad._value),
+                               atol=1e-6)
+
+
+def test_selected_rows_coalesce_and_add():
+    sr = SelectedRows([1, 3, 1], np.ones((3, 2), "float32"), (5, 2))
+    c = sr.coalesce()
+    assert np.asarray(c.rows).tolist() == [1, 3]
+    np.testing.assert_allclose(np.asarray(c.values),
+                               [[2, 2], [1, 1]])
+    both = sr + SelectedRows([0], np.ones((1, 2), "float32"), (5, 2))
+    assert both.rows.shape[0] == 4
+    dense = both + jnp.zeros((5, 2))
+    assert dense.shape == (5, 2)
+
+
+def test_sgd_sparse_update_matches_dense():
+    def run(sparse):
+        paddle.seed(1)
+        emb = nn.Embedding(50, 4, sparse=sparse)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=emb.parameters())
+        ids = paddle.to_tensor(np.array([2, 2, 9], "int64"))
+        loss = (emb(ids) ** 2).sum()
+        loss.backward()
+        opt.step()
+        return np.asarray(emb.weight._value)
+
+    np.testing.assert_allclose(run(True), run(False), atol=1e-6)
+
+
+def test_adam_lazy_sparse_update():
+    paddle.seed(2)
+    emb = nn.Embedding(50, 4, sparse=True)
+    w0 = np.asarray(emb.weight._value).copy()
+    opt = optimizer.Adam(learning_rate=0.1, lazy_mode=True,
+                         parameters=emb.parameters())
+    ids = paddle.to_tensor(np.array([5, 11], "int64"))
+    (emb(ids) ** 2).sum().backward()
+    opt.step()
+    w1 = np.asarray(emb.weight._value)
+    changed = np.abs(w1 - w0).sum(axis=1) > 0
+    assert changed[5] and changed[11]
+    assert changed.sum() == 2  # lazy: ONLY the touched rows moved
+    # non-lazy adam densifies (all-rows moment decay semantics preserved)
+    opt2 = optimizer.Adam(learning_rate=0.1, lazy_mode=False,
+                          parameters=emb.parameters())
+    (emb(ids) ** 2).sum().backward()
+    opt2.step()  # must not raise
+
+
+# --------------------------- Ragged / sequence ----------------------------
+
+def _ragged():
+    return RaggedTensor.from_rows([
+        jnp.asarray([[1., 1.], [2., 2.], [3., 3.]]),
+        jnp.asarray([[4., 4.]]),
+        jnp.asarray([[5., 5.], [6., 6.]]),
+    ])
+
+
+def test_ragged_round_trip_and_lod():
+    r = _ragged()
+    assert r.nrows == 3
+    assert r.recursive_sequence_lengths() == [[3, 1, 2]]
+    assert r.lod == [[0, 3, 4, 6]]
+    padded = r.to_padded()
+    assert padded.shape == (3, 3, 2)
+    assert float(padded[1, 2, 0]) == 0.0  # padding
+    back = RaggedTensor.from_padded(padded, np.asarray(r.lengths))
+    np.testing.assert_allclose(np.asarray(back.values),
+                               np.asarray(r.values))
+
+
+def test_sequence_pool_modes():
+    r = _ragged()
+    np.testing.assert_allclose(np.asarray(ops.sequence_pool(r, "sum")),
+                               [[6, 6], [4, 4], [11, 11]])
+    np.testing.assert_allclose(np.asarray(ops.sequence_pool(r, "average")),
+                               [[2, 2], [4, 4], [5.5, 5.5]])
+    np.testing.assert_allclose(np.asarray(ops.sequence_pool(r, "max")),
+                               [[3, 3], [4, 4], [6, 6]])
+    np.testing.assert_allclose(np.asarray(ops.sequence_first_step(r)),
+                               [[1, 1], [4, 4], [5, 5]])
+    np.testing.assert_allclose(np.asarray(ops.sequence_last_step(r)),
+                               [[3, 3], [4, 4], [6, 6]])
+
+
+def test_sequence_softmax_and_reverse():
+    r = RaggedTensor.from_rows([jnp.asarray([1., 2.]), jnp.asarray([3.])])
+    sm = ops.sequence_softmax(r)
+    e = np.exp([1., 2.])
+    np.testing.assert_allclose(np.asarray(sm.values)[:2], e / e.sum(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sm.values)[2], 1.0)
+    rev = ops.sequence_reverse(_ragged())
+    np.testing.assert_allclose(np.asarray(rev.values)[:3, 0], [3, 2, 1])
+
+
+def test_sequence_expand_concat_slice_pad():
+    ref = _ragged()
+    x = jnp.asarray([[10.], [20.], [30.]])
+    ex = ops.sequence_expand(x, ref)
+    np.testing.assert_allclose(np.asarray(ex.values)[:, 0],
+                               [10, 10, 10, 20, 30, 30])
+    cc = ops.sequence_concat([ref, ref])
+    assert cc.recursive_sequence_lengths() == [[6, 2, 4]]
+    sl = ops.sequence_slice(ref, [0, 0, 1], [2, 1, 1])
+    assert sl.recursive_sequence_lengths() == [[2, 1, 1]]
+    padded, lens = ops.sequence_pad(ref)
+    assert padded.shape == (3, 3, 2)
+    r2 = ops.sequence_unpad(padded, np.asarray(lens))
+    np.testing.assert_allclose(np.asarray(r2.values),
+                               np.asarray(ref.values))
