@@ -1,0 +1,132 @@
+"""Native (C++) runtime pieces, built on demand.
+
+The reference keeps its data-feed/channel tier in C++
+(framework/data_feed.cc, framework/channel.h) because Python can't parse
+fast enough to feed accelerators. Same split here: the MultiSlot parser is
+C++ compiled once per machine into _native/lib/ and bound via ctypes (no
+pybind dependency; ctypes calls release the GIL, so thread pools get real
+file-level parallelism). A pure-Python fallback keeps the API alive when
+no toolchain is present.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "lib", "libpaddle_tpu_native.so")
+_SRC = os.path.join(_HERE, "src", "multislot_parser.cc")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _build():
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    tmp = _SO + ".tmp"
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+        check=True, capture_output=True)
+    os.replace(tmp, _SO)
+
+
+def native_lib():
+    """The loaded ctypes library, building it first if needed; None when
+    unavailable (callers fall back to Python)."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or (
+                    os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_SO)
+            lib.pt_parse_multislot_file.restype = ctypes.c_void_p
+            lib.pt_parse_multislot_file.argtypes = [ctypes.c_char_p,
+                                                    ctypes.c_char_p]
+            lib.pt_ms_rows.restype = ctypes.c_longlong
+            lib.pt_ms_rows.argtypes = [ctypes.c_void_p]
+            lib.pt_ms_error.restype = ctypes.c_char_p
+            lib.pt_ms_error.argtypes = [ctypes.c_void_p]
+            lib.pt_ms_slot_total.restype = ctypes.c_longlong
+            lib.pt_ms_slot_total.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.pt_ms_copy_splits.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                              ctypes.c_void_p]
+            lib.pt_ms_copy_f32.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                           ctypes.c_void_p]
+            lib.pt_ms_copy_i64.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                           ctypes.c_void_p]
+            lib.pt_ms_free.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception:
+            _build_failed = True
+            _lib = None
+        return _lib
+
+
+def parse_multislot_file(path, slot_types):
+    """Parse one MultiSlot text file -> per-slot (values, row_splits)
+    numpy arrays. slot_types: list of 'uint64' | 'float'."""
+    lib = native_lib()
+    if lib is None:
+        return _parse_multislot_py(path, slot_types)
+    h = lib.pt_parse_multislot_file(
+        path.encode(), ",".join(slot_types).encode())
+    if not h:
+        raise IOError(f"cannot parse {path}")
+    try:
+        err = lib.pt_ms_error(h)
+        if err:
+            raise ValueError(f"{path}: {err.decode()}")
+        rows = int(lib.pt_ms_rows(h))
+        out = []
+        for s, t in enumerate(slot_types):
+            total = int(lib.pt_ms_slot_total(h, s))
+            splits = np.empty(rows + 1, np.int64)
+            lib.pt_ms_copy_splits(h, s, splits.ctypes.data_as(
+                ctypes.c_void_p))
+            if t == "float":
+                vals = np.empty(total, np.float32)
+                lib.pt_ms_copy_f32(h, s, vals.ctypes.data_as(
+                    ctypes.c_void_p))
+            else:
+                vals = np.empty(total, np.int64)
+                lib.pt_ms_copy_i64(h, s, vals.ctypes.data_as(
+                    ctypes.c_void_p))
+            out.append((vals, splits))
+        return rows, out
+    finally:
+        lib.pt_ms_free(h)
+
+
+def _parse_multislot_py(path, slot_types):
+    """Pure-Python fallback (same format; reference
+    MultiSlotDataFeed::ParseOneInstance semantics)."""
+    per_slot_vals = [[] for _ in slot_types]
+    per_slot_splits = [[0] for _ in slot_types]
+    rows = 0
+    with open(path) as f:
+        for line in f:
+            toks = line.split()
+            if not toks:
+                continue
+            i = 0
+            for s, t in enumerate(slot_types):
+                n = int(toks[i])
+                i += 1
+                conv = float if t == "float" else int
+                per_slot_vals[s].extend(conv(x) for x in toks[i:i + n])
+                i += n
+                per_slot_splits[s].append(len(per_slot_vals[s]))
+            rows += 1
+    out = []
+    for s, t in enumerate(slot_types):
+        dt = np.float32 if t == "float" else np.int64
+        out.append((np.asarray(per_slot_vals[s], dt),
+                    np.asarray(per_slot_splits[s], np.int64)))
+    return rows, out

@@ -25,6 +25,12 @@ _LAZY_NAMES = {
 
 
 def __getattr__(name):
+    if name in ("InMemoryDataset", "QueueDataset", "DatasetFactory"):
+        # 2.0 API location: paddle.distributed.InMemoryDataset
+        from ..io import fleet_dataset as _fd
+        val = getattr(_fd, name)
+        globals()[name] = val
+        return val
     if name in _LAZY_MODULES:
         mod = _importlib.import_module(f".{name}", __name__)
         globals()[name] = mod

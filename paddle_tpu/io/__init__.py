@@ -14,3 +14,5 @@ from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,  # n
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,  # noqa: F401
                       Sampler, SequenceSampler, WeightedRandomSampler)
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .fleet_dataset import (DatasetBase, DatasetFactory,  # noqa: F401
+                            InMemoryDataset, QueueDataset)

@@ -153,6 +153,46 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
+    # -- dataset/trainer path ------------------------------------------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """The industrial hot path (reference executor.py:1425
+        _run_from_dataset -> framework/executor.cc:165 RunFromDataset ->
+        HogwildWorker::TrainFiles hogwild_worker.cc:196).
+
+        Design delta: the reference spawns one DeviceWorker thread per
+        card, each looping ops over channel batches; on the
+        single-controller runtime ONE loop drives the whole mesh — the
+        compiled step is already data-parallel over the devices, and the
+        dataset's thread pool keeps the parse ahead of the step."""
+        if dataset is None:
+            raise ValueError("train_from_dataset requires a dataset")
+        from ..core import monitor as _monitor
+        it = 0
+        for feed in dataset.batches():
+            outs = self.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+            _monitor.stat_add("executor/dataset_batches")
+            it += 1
+            if debug or (fetch_list and print_period
+                         and it % print_period == 0):
+                names = fetch_info or [getattr(f, "name", str(f))
+                                       for f in (fetch_list or [])]
+                msg = ", ".join(f"{n}={np.asarray(v).mean():.6f}"
+                                for n, v in zip(names, outs))
+                print(f"batch {it}: {msg}")
+        return None
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """reference executor.py infer_from_dataset — same loop, the
+        program simply has no optimizer section."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     @staticmethod
     def _recompute_segments(program, ops, fetch_ids, persist, state_writes,
                             bwd):
