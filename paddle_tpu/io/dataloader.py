@@ -1,10 +1,20 @@
 """DataLoader with background prefetch.
 
 Analog of reference python/paddle/fluid/reader.py DataLoader (:147) +
-dataloader_iter.py. Worker model delta: a thread pool + bounded queue
-(double buffering) instead of forked workers over shared memory — the host
-work here is collation, and overlapping it with device steps is what matters
-on TPU (BufferedReader analog, operators/reader/buffered_reader.h:47).
+dataloader/dataloader_iter.py. Two worker models, like the reference:
+
+- `use_shared_memory=True` (default): FORKED worker processes pulling
+  index lists from a task queue and pushing collated numpy batches back
+  (the reference's _DataLoaderIterMultiProcess, reader.py:147) — real
+  parallelism for Python-heavy transforms the GIL would serialize.
+  Workers should produce numpy (not device arrays): they run before the
+  host->device transfer.
+- `use_shared_memory=False`: a thread pool — enough when __getitem__ is
+  numpy-bound (numpy releases the GIL), zero fork hazards.
+
+Either way a double-buffer queue keeps one batch ahead so host collation
+overlaps the device step (BufferedReader analog,
+operators/reader/buffered_reader.h:47).
 """
 from __future__ import annotations
 
@@ -19,6 +29,31 @@ from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
 __all__ = ["DataLoader", "default_collate_fn"]
+
+
+class _WorkerFailure:
+    """Pickled across the result queue to re-raise in the parent."""
+
+    def __init__(self, exc):
+        self.type_name = type(exc).__name__
+        self.message = str(exc)
+        import traceback
+        self.tb = traceback.format_exc()
+
+
+def _worker_loop(dataset, collate_fn, index_q, result_q, init_fn, wid):
+    if init_fn is not None:
+        init_fn(wid)
+    while True:
+        task = index_q.get()
+        if task is None:
+            return
+        bid, indices = task
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            result_q.put((bid, batch))
+        except BaseException as e:  # noqa: BLE001 — must reach the parent
+            result_q.put((bid, _WorkerFailure(e)))
 
 
 def default_collate_fn(batch):
@@ -48,6 +83,8 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 2)
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -121,9 +158,52 @@ class DataLoader:
             stop.set()  # unblock producer if the consumer bailed early
             pool.shutdown(wait=False)
 
+    def _batches_multiprocess(self):
+        """Forked worker processes; batches re-ordered by index so epoch
+        order matches the sampler regardless of worker timing."""
+        import multiprocessing as mp
+        ctx = mp.get_context("fork")
+        tasks = list(enumerate(self.batch_sampler))
+        index_q = ctx.Queue()
+        result_q = ctx.Queue(
+            maxsize=max(2, self.prefetch_factor) * self.num_workers)
+        workers = [
+            ctx.Process(target=_worker_loop,
+                        args=(self.dataset, self.collate_fn, index_q,
+                              result_q, self.worker_init_fn, wid),
+                        daemon=True)
+            for wid in range(self.num_workers)]
+        for w in workers:
+            w.start()
+        try:
+            for t in tasks:
+                index_q.put(t)
+            for _ in workers:
+                index_q.put(None)
+            expected, cache, received = 0, {}, 0
+            while received < len(tasks):
+                bid, payload = result_q.get()
+                received += 1
+                if isinstance(payload, _WorkerFailure):
+                    raise RuntimeError(
+                        f"DataLoader worker failed: {payload.type_name}: "
+                        f"{payload.message}\n{payload.tb}")
+                cache[bid] = payload
+                while expected in cache:
+                    yield cache.pop(expected)
+                    expected += 1
+        finally:
+            for w in workers:
+                if w.is_alive():
+                    w.terminate()
+                w.join(timeout=5)
+
     def __iter__(self):
         if self.num_workers > 0 and not self._iterable_mode:
-            gen = self._batches_threaded()
+            if self.use_shared_memory:
+                gen = self._batches_multiprocess()
+            else:
+                gen = self._batches_threaded()
         else:
             gen = self._batches()
         if not self.use_buffer_reader:
