@@ -16,5 +16,7 @@ from .conv import *          # noqa: F401,F403
 from .norm_ops import *      # noqa: F401,F403
 from .loss import *          # noqa: F401,F403
 from .sequence import *      # noqa: F401,F403
+from .math_extra import *    # noqa: F401,F403
+from .detection import *     # noqa: F401,F403
 
 from . import _bind  # attaches Tensor operators/methods  # noqa: F401,E402

@@ -1,0 +1,367 @@
+"""Detection op family.
+
+Analog of reference paddle/fluid/operators/detection/ (~4k LoC of SSD/YOLO
+box machinery: iou_similarity_op.cc, box_coder_op.cc, prior_box_op.cc,
+yolo_box_op.cc, multiclass_nms_op.cc, bipartite_match_op.cc,
+roi_align_op.cc, roi_pool_op.cc, box_clip_op.cc).
+
+TPU design split: dense geometry (iou, coders, priors, yolo decode,
+roi_align/pool) lowers to jnp — static shapes, fully jittable, roi_align
+differentiable. Selection ops with data-dependent output sizes (nms
+families, bipartite match) run as eager host kernels exactly like the
+reference's CPU-only kernels for the same ops (multiclass_nms_op.cc has
+no CUDA kernel either) — they sit at the postprocessing boundary where
+the device step has already ended.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._dispatch import defop
+
+__all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box",
+           "box_clip", "roi_align", "roi_pool", "nms", "multiclass_nms",
+           "bipartite_match"]
+
+
+@defop
+def iou_similarity(x, y, box_normalized=True):
+    """[N,4] x [M,4] -> [N,M] IoU (reference iou_similarity_op.cc)."""
+    off = 0.0 if box_normalized else 1.0
+    ax1, ay1, ax2, ay2 = jnp.split(x, 4, axis=-1)        # [N,1]
+    bx1, by1, bx2, by2 = [v.T for v in jnp.split(y, 4, axis=-1)]  # [1,M]
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1) + off, 0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1) + off, 0)
+    inter = iw * ih
+    area_a = (ax2 - ax1 + off) * (ay2 - ay1 + off)
+    area_b = (bx2 - bx1 + off) * (by2 - by1 + off)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+
+@defop
+def box_coder(prior_box_, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0):
+    """reference box_coder_op.cc: encode/decode against priors."""
+    off = 0.0 if box_normalized else 1.0
+    pw = prior_box_[:, 2] - prior_box_[:, 0] + off
+    ph = prior_box_[:, 3] - prior_box_[:, 1] + off
+    pcx = prior_box_[:, 0] + pw * 0.5
+    pcy = prior_box_[:, 1] + ph * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((1, 4), target_box.dtype)
+    else:
+        var = prior_box_var.reshape(-1, 4)
+    if code_type.startswith("encode"):
+        tw = target_box[:, 2] - target_box[:, 0] + off
+        th = target_box[:, 3] - target_box[:, 1] + off
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(tw[:, None] / pw[None, :])
+        dh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)      # [N,M,4]
+        return out / var[None, :, :] if var.shape[0] > 1 else out / var
+    # decode: target_box [N, M, 4] deltas (or [N,4] with broadcast priors)
+    t = target_box if target_box.ndim == 3 else target_box[:, None, :]
+    v = var if var.shape[0] > 1 else jnp.broadcast_to(var, (pw.shape[0], 4))
+    if axis == 0:
+        pcx_, pcy_, pw_, ph_ = (a[None, :] for a in (pcx, pcy, pw, ph))
+        v = v[None, :, :]
+    else:
+        pcx_, pcy_, pw_, ph_ = (a[:, None] for a in (pcx, pcy, pw, ph))
+        v = v[:, None, :]
+    cx = v[..., 0] * t[..., 0] * pw_ + pcx_
+    cy = v[..., 1] * t[..., 1] * ph_ + pcy_
+    w = jnp.exp(v[..., 2] * t[..., 2]) * pw_
+    h = jnp.exp(v[..., 3] * t[..., 3]) * ph_
+    out = jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                     cx + w * 0.5 - off, cy + h * 0.5 - off], axis=-1)
+    return out.reshape(target_box.shape)
+
+
+@defop
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),  # noqa: A002
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5):
+    """reference prior_box_op.cc (SSD anchor generation)."""
+    fh, fw = input.shape[-2], input.shape[-1]
+    ih, iw = image.shape[-2], image.shape[-1]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    whs = []
+    for ms in min_sizes:
+        for ar in ars:
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            for mx in max_sizes:
+                whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    whs = jnp.asarray(whs)                                 # [P, 2]
+    cx = (jnp.arange(fw) + offset) * step_w
+    cy = (jnp.arange(fh) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)                        # [fh, fw]
+    cxy = jnp.stack([cxg, cyg], -1)[:, :, None, :]         # [fh,fw,1,2]
+    half = whs[None, None, :, :] * 0.5
+    mins = (cxy - half) / jnp.asarray([iw, ih])
+    maxs = (cxy + half) / jnp.asarray([iw, ih])
+    boxes = jnp.concatenate([mins, maxs], -1)              # [fh,fw,P,4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), boxes.shape)
+    return boxes, var
+
+
+@defop
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """reference yolo_box_op.cc: decode YOLOv3 head output [N, A*(5+C), H, W]."""
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, x.dtype).reshape(na, 2)
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    gx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2 + jnp.arange(w)[None, None, None, :]) / w
+    gy = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2 + jnp.arange(h)[None, None, :, None]) / h
+    input_w = downsample_ratio * w
+    input_h = downsample_ratio * h
+    gw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    gh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].astype(x.dtype)[:, None]
+    imw = img_size[:, 1].astype(x.dtype)[:, None]
+    flat = lambda v: v.reshape(n, -1)  # noqa: E731
+    x1 = flat(gx - gw * 0.5) * imw
+    y1 = flat(gy - gh * 0.5) * imh
+    x2 = flat(gx + gw * 0.5) * imw
+    y2 = flat(gy + gh * 0.5) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    mask = flat(conf) > conf_thresh
+    boxes = boxes * mask[..., None]
+    scores = scores * mask[..., None]
+    return boxes, scores
+
+
+@defop
+def box_clip(input, im_info):  # noqa: A002
+    """reference box_clip_op.cc: clip boxes to image."""
+    h = im_info[..., 0:1] - 1
+    w = im_info[..., 1:2] - 1
+    x1 = jnp.clip(input[..., 0::4], 0, w)
+    y1 = jnp.clip(input[..., 1::4], 0, h)
+    x2 = jnp.clip(input[..., 2::4], 0, w)
+    y2 = jnp.clip(input[..., 3::4], 0, h)
+    out = jnp.stack([x1, y1, x2, y2], -1)
+    return out.reshape(input.shape)
+
+
+@defop
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """reference roi_align_op.cc: bilinear ROI pooling, differentiable.
+    x: [N,C,H,W]; boxes: [R,4] (x1,y1,x2,y2) on image scale; boxes_num:
+    rois per batch image (None => all on image 0)."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    n, c, H, W = x.shape
+    r = boxes.shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((r,), jnp.int32)
+    else:
+        batch_idx = jnp.repeat(jnp.arange(len(boxes_num)),
+                               jnp.asarray(boxes_num),
+                               total_repeat_length=r).astype(jnp.int32)
+    off = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - off
+    y1 = boxes[:, 1] * spatial_scale - off
+    x2 = boxes[:, 2] * spatial_scale - off
+    y2 = boxes[:, 3] * spatial_scale - off
+    rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+    rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [R, oh*sr, ow*sr]
+    ys = y1[:, None] + rh[:, None] * (jnp.arange(oh * sr) + 0.5) / (oh * sr)
+    xs = x1[:, None] + rw[:, None] * (jnp.arange(ow * sr) + 0.5) / (ow * sr)
+
+    def bilinear(img, yy, xx):
+        y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+        y1_ = jnp.clip(y0 + 1, 0, H - 1)
+        x1_ = jnp.clip(x0 + 1, 0, W - 1)
+        wy = jnp.clip(yy, 0, H - 1) - y0
+        wx = jnp.clip(xx, 0, W - 1) - x0
+        y0i, x0i, y1i, x1i = (v.astype(jnp.int32) for v in (y0, x0, y1_, x1_))
+        g = lambda yi, xi: img[:, yi, xi]  # noqa: E731  [C, ...]
+        return (g(y0i, x0i) * (1 - wy) * (1 - wx) + g(y0i, x1i) * (1 - wy) * wx
+                + g(y1i, x0i) * wy * (1 - wx) + g(y1i, x1i) * wy * wx)
+
+    def per_roi(bi, yy, xx):
+        img = x[bi]                                   # [C,H,W]
+        grid_y = jnp.repeat(yy, ow * sr)              # [(oh*sr)*(ow*sr)]
+        grid_x = jnp.tile(xx, oh * sr)
+        vals = bilinear(img, grid_y, grid_x)          # [C, ohsr*owsr]
+        vals = vals.reshape(c, oh, sr, ow, sr)
+        return vals.mean(axis=(2, 4))                 # [C, oh, ow]
+
+    return jax.vmap(per_roi)(batch_idx, ys, xs)
+
+
+@defop
+def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
+    """reference roi_pool_op.cc: max pooling over quantized ROI bins."""
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    n, c, H, W = x.shape
+    r = boxes.shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((r,), jnp.int32)
+    else:
+        batch_idx = jnp.repeat(jnp.arange(len(boxes_num)),
+                               jnp.asarray(boxes_num),
+                               total_repeat_length=r).astype(jnp.int32)
+    x1 = jnp.round(boxes[:, 0] * spatial_scale).astype(jnp.int32)
+    y1 = jnp.round(boxes[:, 1] * spatial_scale).astype(jnp.int32)
+    x2 = jnp.round(boxes[:, 2] * spatial_scale).astype(jnp.int32)
+    y2 = jnp.round(boxes[:, 3] * spatial_scale).astype(jnp.int32)
+
+    SR = 8  # fixed sample lattice per bin (static shapes; max over samples)
+
+    def per_roi(bi, xx1, yy1, xx2, yy2):
+        img = x[bi]
+        rh = jnp.maximum(yy2 - yy1 + 1, 1)
+        rw = jnp.maximum(xx2 - xx1 + 1, 1)
+        ys = yy1 + (jnp.arange(oh * SR) * rh) // (oh * SR)
+        xs = xx1 + (jnp.arange(ow * SR) * rw) // (ow * SR)
+        ys = jnp.clip(ys, 0, H - 1)
+        xs = jnp.clip(xs, 0, W - 1)
+        grid_y = jnp.repeat(ys, ow * SR)
+        grid_x = jnp.tile(xs, oh * SR)
+        vals = img[:, grid_y, grid_x].reshape(c, oh, SR, ow, SR)
+        return vals.max(axis=(2, 4))
+
+    return jax.vmap(per_roi)(batch_idx, x1, y1, x2, y2)
+
+
+# -- host-side selection kernels (eager; reference ships CPU-only too) ------
+
+def _nms_np(boxes, scores, threshold, top_k=-1):
+    order = np.argsort(-scores)
+    keep = []
+    suppressed = np.zeros(len(boxes), bool)
+    areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        if top_k > 0 and len(keep) >= top_k:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[:, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[:, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[:, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[:, 3])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        suppressed |= iou > threshold
+    return np.asarray(keep, np.int64)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """reference nms_op / multiclass path. Eager host kernel (dynamic
+    output size, like the reference's CPU-only kernel)."""
+    from ..core.tensor import Tensor
+    b = np.asarray(boxes._value if isinstance(boxes, Tensor) else boxes)
+    s = np.asarray(scores._value if isinstance(scores, Tensor) else scores) \
+        if scores is not None else np.arange(len(b), 0, -1, dtype=np.float32)
+    if category_idxs is not None:
+        cats = np.asarray(category_idxs._value
+                          if isinstance(category_idxs, Tensor)
+                          else category_idxs)
+        keep_all = []
+        for cval in (categories if categories is not None
+                     else np.unique(cats)):
+            idx = np.nonzero(cats == cval)[0]
+            kept = _nms_np(b[idx], s[idx], iou_threshold)
+            keep_all.append(idx[kept])
+        keep = np.concatenate(keep_all) if keep_all else np.zeros(0, np.int64)
+        keep = keep[np.argsort(-s[keep])]
+    else:
+        keep = _nms_np(b, s, iou_threshold)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep), _internal=True)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   background_label=0):
+    """reference multiclass_nms_op.cc: per-class NMS then global keep_top_k.
+    bboxes [N, M, 4]; scores [N, C, M]. Returns (out [K, 6], rois_num)."""
+    from ..core.tensor import Tensor
+    b = np.asarray(bboxes._value if isinstance(bboxes, Tensor) else bboxes)
+    s = np.asarray(scores._value if isinstance(scores, Tensor) else scores)
+    outs, nums = [], []
+    for n in range(b.shape[0]):
+        dets = []
+        for c in range(s.shape[1]):
+            if c == background_label:
+                continue
+            sc = s[n, c]
+            m = sc > score_threshold
+            if not m.any():
+                continue
+            idx = np.nonzero(m)[0]
+            order = idx[np.argsort(-sc[idx])][:nms_top_k]
+            kept = _nms_np(b[n][order], sc[order], nms_threshold)
+            for i in order[kept]:
+                dets.append([c, sc[i], *b[n, i]])
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        outs.extend(dets)
+        nums.append(len(dets))
+    out = np.asarray(outs, np.float32).reshape(-1, 6)
+    return (Tensor(jnp.asarray(out), _internal=True),
+            Tensor(jnp.asarray(np.asarray(nums, np.int32)), _internal=True))
+
+
+def bipartite_match(dist_mat):
+    """reference bipartite_match_op.cc greedy bipartite matching:
+    repeatedly take the global max entry, match that (row, col) pair.
+    Returns (match_indices [M], match_dist [M]) over columns."""
+    from ..core.tensor import Tensor
+    d = np.array(np.asarray(dist_mat._value
+                            if isinstance(dist_mat, Tensor) else dist_mat),
+                 copy=True)
+    n, m = d.shape
+    match_idx = np.full(m, -1, np.int64)
+    match_dist = np.zeros(m, np.float32)
+    used_rows = np.zeros(n, bool)
+    used_cols = np.zeros(m, bool)
+    for _ in range(min(n, m)):
+        i, j = np.unravel_index(np.argmax(d), d.shape)
+        if d[i, j] <= 0:
+            break
+        match_idx[j] = i
+        match_dist[j] = d[i, j]
+        used_rows[i] = True
+        used_cols[j] = True
+        d[i, :] = -1
+        d[:, j] = -1
+    return (Tensor(jnp.asarray(match_idx), _internal=True),
+            Tensor(jnp.asarray(match_dist), _internal=True))

@@ -1,0 +1,205 @@
+"""Extended math + detection op families vs numpy/scipy references
+(reference golden-op discipline, unittests/op_test.py:232)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+from op_test import check_output, numeric_grad
+
+T = paddle.to_tensor
+
+
+def test_special_functions():
+    import scipy.special as sp
+    x = np.abs(np.random.RandomState(0).randn(8)).astype("float32") + 0.5
+    np.testing.assert_allclose(ops.gammaln(T(x)).numpy(), sp.gammaln(x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(ops.i0(T(x)).numpy(), sp.i0(x), rtol=1e-5)
+    np.testing.assert_allclose(ops.i1e(T(x)).numpy(), sp.i1e(x), rtol=1e-5)
+    np.testing.assert_allclose(ops.igamma(T(x), T(x)).numpy(),
+                               sp.gammainc(x, x), rtol=1e-5)
+    np.testing.assert_allclose(ops.polygamma(T(x), n=1).numpy(),
+                               sp.polygamma(1, x), rtol=2e-4)
+
+
+def test_elementwise_extras():
+    rng = np.random.RandomState(1)
+    x = rng.randn(6).astype("float32")
+    y = rng.randn(6).astype("float32")
+    check_output(ops.hypot, np.hypot, [x, y])
+    check_output(ops.copysign, np.copysign, [x, y])
+    check_output(ops.sinc, np.sinc, [x])
+    assert (ops.signbit(T(x)).numpy() == np.signbit(x)).all()
+    np.testing.assert_allclose(ops.fix(T(x * 3)).numpy(), np.trunc(x * 3))
+    m, e = ops.frexp(T(x))
+    np.testing.assert_allclose(m.numpy() * (2.0 ** e.numpy()), x,
+                               rtol=1e-6)
+
+
+def test_trapezoid_and_cumulative():
+    y = np.array([1.0, 2.0, 3.0, 4.0], "float32")
+    np.testing.assert_allclose(ops.trapezoid(T(y)).numpy(),
+                               np.trapezoid(y))
+    ct = ops.cumulative_trapezoid(T(y)).numpy()
+    np.testing.assert_allclose(ct, [1.5, 4.0, 7.5])
+
+
+def test_cummax_cummin():
+    x = np.array([[1.0, 3.0, 2.0], [4.0, 1.0, 5.0]], "float32")
+    vals, idx = ops.cummax(T(x), axis=1)
+    np.testing.assert_allclose(vals.numpy(), [[1, 3, 3], [4, 4, 5]])
+    np.testing.assert_array_equal(idx.numpy(), [[0, 1, 1], [0, 0, 2]])
+    vals, idx = ops.cummin(T(x), axis=1)
+    np.testing.assert_allclose(vals.numpy(), [[1, 1, 1], [4, 1, 1]])
+
+
+def test_indexing_ops():
+    x = np.zeros((4, 3), "float32")
+    out = ops.index_add(T(x), T(np.array([0, 2])), 0,
+                        T(np.ones((2, 3), "float32")))
+    assert out.numpy()[0].sum() == 3 and out.numpy()[2].sum() == 3
+    out = ops.index_fill(T(x), T(np.array([1])), 0, 7.0)
+    assert (out.numpy()[1] == 7).all()
+    out = ops.bucketize(T(np.array([0.5, 3.5, 9.0])),
+                        T(np.array([1.0, 2.0, 4.0])))
+    np.testing.assert_array_equal(out.numpy(), [0, 2, 3])
+    sc = ops.select_scatter(T(x), T(np.full(3, 5.0, "float32")), 0, 2)
+    assert (sc.numpy()[2] == 5).all()
+    ms = ops.masked_scatter(T(x), T(x == 0),
+                            T(np.arange(12, dtype="float32")))
+    np.testing.assert_allclose(ms.numpy().reshape(-1), np.arange(12))
+
+
+def test_distances_and_stats():
+    import scipy.spatial.distance as sd
+    rng = np.random.RandomState(2)
+    a = rng.randn(5, 3).astype("float32")
+    b = rng.randn(4, 3).astype("float32")
+    np.testing.assert_allclose(ops.cdist(T(a), T(b)).numpy(),
+                               sd.cdist(a, b), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ops.pdist(T(a)).numpy(), sd.pdist(a),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ops.cov(T(a)).numpy(), np.cov(a),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ops.corrcoef(T(a)).numpy(), np.corrcoef(a),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lu_roundtrip_and_cholesky_solve():
+    rng = np.random.RandomState(3)
+    A = rng.randn(4, 4).astype("float32")
+    A = A @ A.T + 4 * np.eye(4, dtype="float32")
+    lu_mat, piv = ops.lu(T(A))
+    P, L, U = ops.lu_unpack(lu_mat, piv)
+    np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), A,
+                               atol=1e-4)
+    c = np.linalg.cholesky(A).astype("float32")
+    bvec = rng.randn(4, 1).astype("float32")
+    xs = ops.cholesky_solve(T(bvec), T(c))
+    np.testing.assert_allclose(A @ xs.numpy(), bvec, atol=1e-3)
+
+
+def test_fold_inverts_unfold():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    cols = ops.unfold(T(x), kernel_sizes=2, strides=2)
+    back = ops.fold(cols, output_sizes=(8, 8), kernel_sizes=2, strides=2)
+    np.testing.assert_allclose(back.numpy(), x, atol=1e-6)
+
+
+def test_random_extras():
+    g = ops.standard_gamma(T(np.full(2000, 3.0, "float32")))
+    assert abs(float(g.numpy().mean()) - 3.0) < 0.3
+    b = ops.binomial(T(np.full(2000, 10.0)), T(np.full(2000, 0.5)))
+    assert abs(float(np.asarray(b.numpy()).mean()) - 5.0) < 0.5
+
+
+# ------------------------------ detection ---------------------------------
+
+def test_iou_similarity():
+    a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], "float32")
+    iou = ops.iou_similarity(T(a), T(a)).numpy()
+    np.testing.assert_allclose(np.diag(iou), [1.0, 1.0])
+    np.testing.assert_allclose(iou[0, 1], 1.0 / 7.0, rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[0, 0, 4, 4], [2, 2, 8, 8]], "float32")
+    targets = np.array([[1, 1, 3, 3]], "float32")
+    enc = ops.box_coder(T(priors), None, T(targets),
+                        code_type="encode_center_size").numpy()  # [1,2,4]
+    dec = ops.box_coder(T(priors), None,
+                        T(enc.astype("float32")),
+                        code_type="decode_center_size", axis=0).numpy()
+    np.testing.assert_allclose(dec[0, 0], targets[0], atol=1e-4)
+
+
+def test_prior_box_shapes():
+    feat = np.zeros((1, 8, 4, 4), "float32")
+    img = np.zeros((1, 3, 32, 32), "float32")
+    boxes, var = ops.prior_box(T(feat), T(img), min_sizes=[8.0],
+                               aspect_ratios=[1.0, 2.0], flip=True)
+    assert boxes.numpy().shape == (4, 4, 3, 4)
+    assert var.numpy().shape == (4, 4, 3, 4)
+    assert np.isfinite(boxes.numpy()).all()
+
+
+def test_yolo_box_shapes():
+    n, anchors, C, h = 1, [10, 13, 16, 30], 2, 4
+    x = np.random.RandomState(5).randn(
+        n, 2 * (5 + C), h, h).astype("float32")
+    img = np.array([[64, 64]], "int32")
+    boxes, scores = ops.yolo_box(T(x), T(img), anchors, C)
+    assert boxes.numpy().shape == (1, 2 * h * h, 4)
+    assert scores.numpy().shape == (1, 2 * h * h, C)
+
+
+def test_nms_and_multiclass():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     "float32")
+    scores = np.array([0.9, 0.8, 0.7], "float32")
+    keep = ops.nms(T(boxes), 0.5, scores=T(scores)).numpy()
+    np.testing.assert_array_equal(keep, [0, 2])
+    s = np.zeros((1, 2, 3), "float32")
+    s[0, 1] = scores
+    out, nums = ops.multiclass_nms(T(boxes[None]), T(s),
+                                   score_threshold=0.1, nms_threshold=0.5)
+    assert int(nums.numpy()[0]) == 2
+    assert out.numpy().shape == (2, 6)
+
+
+def test_bipartite_match():
+    d = np.array([[0.9, 0.1], [0.2, 0.8]], "float32")
+    idx, dist = ops.bipartite_match(T(d))
+    np.testing.assert_array_equal(idx.numpy(), [0, 1])
+    np.testing.assert_allclose(dist.numpy(), [0.9, 0.8])
+
+
+def test_roi_align_and_pool():
+    x = np.arange(2 * 1 * 8 * 8, dtype="float32").reshape(2, 1, 8, 8)
+    boxes = np.array([[0, 0, 4, 4], [2, 2, 6, 6]], "float32")
+    out = ops.roi_align(T(x), T(boxes), boxes_num=[1, 1], output_size=2)
+    assert out.numpy().shape == (2, 1, 2, 2)
+    assert np.isfinite(out.numpy()).all()
+    # differentiable: grads flow to the feature map
+    xt = T(x)
+    xt.stop_gradient = False
+    ops.roi_align(xt, T(boxes), boxes_num=[1, 1],
+                  output_size=2).sum().backward()
+    assert np.abs(np.asarray(xt.grad._value)).sum() > 0
+    out = ops.roi_pool(T(x), T(boxes), boxes_num=[1, 1], output_size=2)
+    assert out.numpy().shape == (2, 1, 2, 2)
+    # roi_pool of a monotone ramp: max of each bin is its bottom-right
+    assert float(out.numpy()[0, 0, 1, 1]) >= float(out.numpy()[0, 0, 0, 0])
+
+
+def test_grad_check_selected_extras():
+    rng = np.random.RandomState(6)
+    x = rng.rand(3, 3).astype("float64") + 0.5
+    g_an = paddle.to_tensor(x)
+    g_an.stop_gradient = False
+    ops.gammaln(g_an).sum().backward()
+    g_num = numeric_grad(ops.gammaln, [x], 0)
+    np.testing.assert_allclose(np.asarray(g_an.grad._value), g_num,
+                               rtol=5e-3, atol=1e-3)
