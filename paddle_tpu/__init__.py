@@ -69,7 +69,7 @@ import importlib as _importlib
 _SUBMODULES = ("nn", "optimizer", "metric", "io", "amp", "static",
                "distributed", "vision", "jit", "hapi", "incubate",
                "profiler", "text", "sysconfig", "callbacks", "inference",
-               "framework", "regularizer", "memory")
+               "framework", "regularizer", "memory", "quantization")
 
 
 def __getattr__(name):

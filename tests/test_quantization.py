@@ -1,0 +1,83 @@
+"""Quantization: fake-quant STE, QAT wrapping, PTQ calibration, int8
+export (reference slim/quantization + fake_quantize_op.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.quantization import (PTQ, QAT, QuantConfig, fake_quant,
+                                     weight_quantize)
+
+T = paddle.to_tensor
+
+
+def test_fake_quant_values_and_ste():
+    x = T(np.array([-2.0, -0.5, 0.0, 0.4, 2.0], "float32"))
+    x.stop_gradient = False
+    y = fake_quant(x, 1.0, bits=8)
+    v = y.numpy()
+    assert abs(v[2]) < 1e-7
+    assert v[0] == -1.0 and v[-1] == 1.0        # clipped to scale
+    assert abs(v[3] - 0.4) < 1.0 / 127          # quantization step
+    y.sum().backward()
+    g = np.asarray(x.grad._value)
+    np.testing.assert_allclose(g, [0, 1, 1, 1, 0])  # STE inside the range
+
+
+def test_qat_trains_and_converges():
+    paddle.seed(0)
+    np.random.seed(0)
+    X = np.random.rand(64, 8).astype("float32")
+    Y = X @ np.random.rand(8, 1).astype("float32")
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    qat = QAT(QuantConfig())
+    net = qat.quantize(net)
+    from paddle_tpu.quantization import QuantedLinear
+    assert sum(isinstance(s, QuantedLinear)
+               for _, s in net.named_sublayers()) == 2
+    opt = optimizer.Adam(learning_rate=0.02, parameters=net.parameters())
+    losses = []
+    for _ in range(60):
+        loss = ((net(T(X)) - T(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+    qat.convert(net)
+    out = net(T(X))
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_ptq_calibration_sets_scales():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(4, 4))
+    ptq = PTQ()
+    net = ptq.quantize(net)
+    big = T((np.random.rand(16, 4) * 5).astype("float32"))
+    for _ in range(3):
+        net(big)  # calibration passes
+    from paddle_tpu.quantization import AbsmaxObserver
+    obs = [s for _, s in net.named_sublayers()
+           if isinstance(s, AbsmaxObserver)]
+    assert obs and float(obs[0].scale.numpy()) > 2.0  # saw the range
+    ptq.convert(net)
+    scale_frozen = float(obs[0].scale.numpy())
+    net(T(np.full((4, 4), 100.0, "float32")))
+    assert float(obs[0].scale.numpy()) == scale_frozen
+
+
+def test_weight_quantize_export():
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(4, 8))
+    net = QAT().quantize(net)
+    packs = weight_quantize(net)
+    assert len(packs) == 1
+    (pack,) = packs.values()
+    assert pack["int8"].dtype == np.int8
+    # dequantized int8 approximates the float weight
+    deq = pack["int8"].astype(np.float32) / 127.0 * pack["scale"]
+    target = np.asarray([s for _, s in net.named_sublayers()
+                         if type(s).__name__ == "QuantedLinear"
+                         ][0].inner.weight._value)
+    np.testing.assert_allclose(deq, target, atol=np.abs(target).max() / 100)
