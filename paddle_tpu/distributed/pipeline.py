@@ -37,14 +37,30 @@ def micro_batch(x, num_micro):
     return x.reshape((num_micro, b // num_micro) + x.shape[1:])
 
 
-def gpipe(stage_fn: Callable, x_micro, axis: str = "pp"):
-    """GPipe schedule inside shard_map.
+def gpipe(stage_fn: Callable, x_micro, axis: str = "pp", schedule="gpipe"):
+    """Pipelined forward inside shard_map.
 
     stage_fn(h) -> h: THIS rank's stage (closed over its local params),
     hidden-shaped in and out. x_micro: [M, mb, ...] hidden-shaped
     microbatches (only stage 0 actually consumes them).
     Returns [M, mb, ...]; entries are the completed pipeline outputs on the
     LAST stage (garbage elsewhere — mask by rank).
+
+    schedule:
+      - "gpipe": plain F-then-B under AD (reference section_worker.cc
+        :61-117 semantics) — residuals for all M microbatches live at once.
+      - "1f1b": each tick is wrapped in jax.checkpoint, so AD stores only
+        the tick-boundary hidden states (O(M+n) hiddens) and recomputes
+        intra-stage activations when that microbatch's backward fires —
+        the activation-stash bound that motivates the classic 1F1B
+        schedule (Megatron PipeDream-flush), expressed the SPMD way.
+
+    Design note: under single-program SPMD all ranks trace ONE program, so
+    a literally rank-divergent 1F1B tick order (warmup depth n-1-r) can't
+    be expressed — ranks would need different collective sequences. What
+    the schedule buys — bounded activation memory and back-pressure — is
+    what "1f1b" provides via per-tick remat; the compute-skip of idle
+    ticks remains masked, exactly as the reference's bubble ticks idle.
     """
     n = mesh_mod.mesh_axis_size(axis)
     rank = lax.axis_index(axis)
@@ -53,12 +69,19 @@ def gpipe(stage_fn: Callable, x_micro, axis: str = "pp"):
     perm = [(i, (i + 1) % n) for i in range(n)]
     is_first = (rank == 0)
 
+    tick_fn = stage_fn
+    if schedule == "1f1b":
+        import jax
+        tick_fn = jax.checkpoint(stage_fn)
+    elif schedule != "gpipe":
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
     carry = jnp.zeros_like(x_micro[0])
     outs = jnp.zeros_like(x_micro)
     for t in range(ticks):
         inject = x_micro[min(t, M - 1)]
         h = jnp.where(is_first, inject, carry)
-        h_out = stage_fn(h)
+        h_out = tick_fn(h)
         mb_done = t - (n - 1)
         if 0 <= mb_done < M:
             outs = outs.at[mb_done].set(h_out)
@@ -66,13 +89,15 @@ def gpipe(stage_fn: Callable, x_micro, axis: str = "pp"):
     return outs
 
 
-def pipeline_loss(stage_fn, loss_fn, x_micro, labels_micro, axis="pp"):
+def pipeline_loss(stage_fn, loss_fn, x_micro, labels_micro, axis="pp",
+                  schedule="gpipe"):
     """Mean microbatch loss of the pipelined stack; identical scalar on all
     ranks (each rank's grads flow only to its own stage params through the
-    permutes — the SectionWorker F-then-B equivalent under AD)."""
+    permutes — the SectionWorker F-then-B equivalent under AD). Pass
+    schedule="1f1b" for the bounded-activation-memory variant."""
     n = mesh_mod.mesh_axis_size(axis)
     rank = lax.axis_index(axis)
-    outs = gpipe(stage_fn, x_micro, axis)
+    outs = gpipe(stage_fn, x_micro, axis, schedule=schedule)
     M = x_micro.shape[0]
     total = jnp.zeros((), jnp.float32)
     on_last = (rank == n - 1).astype(jnp.float32)
@@ -80,3 +105,9 @@ def pipeline_loss(stage_fn, loss_fn, x_micro, labels_micro, axis="pp"):
         total = total + loss_fn(outs[m], labels_micro[m]).astype(jnp.float32) \
             * on_last
     return lax.psum(total, axis) / M
+
+
+def bubble_fraction(num_micro: int, num_stages: int) -> float:
+    """Pipeline bubble overhead (n-1)/(M+n-1) — the schedule-quality
+    accounting the reference leaves implicit in SectionWorker."""
+    return (num_stages - 1) / (num_micro + num_stages - 1)

@@ -333,3 +333,46 @@ def test_zero_sharded_dp(mesh8):
     model.fit(TensorDataset([X, Y]), batch_size=64, epochs=6, verbose=0,
               callbacks=[h], drop_last=True)
     assert h.history["loss"][-1] < h.history["loss"][0]
+
+
+def test_pipeline_1f1b_schedule_matches_gpipe():
+    """schedule='1f1b' (per-tick remat, bounded activation stash) must be
+    numerically identical to gpipe — rematerialization changes memory,
+    never math. Swept over microbatch counts."""
+    from paddle_tpu.distributed.pipeline import bubble_fraction
+    mesh = mesh_mod.init_mesh({"pp": 8}, name="default")
+    rng = np.random.RandomState(3)
+    d = 4
+    ws = rng.randn(8, d, d).astype("float32") * 0.5
+    x = rng.randn(16, d).astype("float32")
+    y = rng.randn(16, d).astype("float32")
+
+    def run(schedule, n_micro):
+        xm = micro_batch(jnp.asarray(x), n_micro)
+        ym = micro_batch(jnp.asarray(y), n_micro)
+
+        def spmd_loss(ws_l, xm_l, ym_l):
+            def stage(h):
+                return jnp.tanh(h @ ws_l[0])
+
+            def mb_loss(h, lbl):
+                return jnp.mean((h - lbl) ** 2)
+
+            return pipeline_loss(stage, mb_loss, xm_l, ym_l, axis="pp",
+                                 schedule=schedule)
+
+        def outer(ws_full):
+            return jax.shard_map(spmd_loss, mesh=mesh,
+                                 in_specs=(P("pp"), P(), P()),
+                                 out_specs=P())(ws_full, xm, ym).mean()
+
+        return jax.value_and_grad(outer)(jnp.asarray(ws))
+
+    for n_micro in (2, 4, 8):  # bubble 0.78 -> 0.64 -> 0.47
+        l0, g0 = run("gpipe", n_micro)
+        l1, g1 = run("1f1b", n_micro)
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
+                                   rtol=1e-5, atol=1e-7)
+    assert bubble_fraction(8, 8) < bubble_fraction(2, 8)
+    mesh_mod.init_mesh({"dp": 8})
