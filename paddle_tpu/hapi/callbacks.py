@@ -11,7 +11,7 @@ import numpy as np
 
 __all__ = ["Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
            "EarlyStopping", "LRSchedulerCallback", "History",
-           "ProfilerCallback",
+           "ProfilerCallback", "VisualDL",
            "config_callbacks"]
 
 
@@ -215,6 +215,31 @@ class LRSchedulerCallback(Callback):
     def on_train_batch_end(self, step, logs=None):
         if self.by_step and self._sched() is not None:
             self._sched().step()
+
+
+class VisualDL(Callback):
+    """Stream per-step loss and per-epoch metrics to a LogWriter
+    (reference hapi/callbacks.py VisualDL; zero-egress JSON-lines form,
+    paddle_tpu.utils.LogWriter)."""
+
+    def __init__(self, log_dir):
+        from ..utils.log_writer import LogWriter
+        self.writer = LogWriter(log_dir)
+        self._step = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if logs and "loss" in logs:
+            self.writer.add_scalar("train/loss", logs["loss"], self._step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            if isinstance(v, (int, float)):
+                self.writer.add_scalar(f"epoch/{k}", v, epoch)
+        self.writer.flush()
+
+    def on_end(self, mode, logs=None):
+        self.writer.close()
 
 
 class ProfilerCallback(Callback):

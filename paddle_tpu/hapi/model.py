@@ -583,23 +583,35 @@ class Model:
                 self._global_step = 0
         self._acp = acp
 
+        import contextlib
+        guard = contextlib.nullcontext()
+        if acp is not None:
+            from ..incubate.checkpoint import PreemptionGuard
+            self._acp_pos = (start_epoch, max(skip_steps - 1, 0))
+            guard = PreemptionGuard(
+                acp, lambda: (self._global_step,
+                              acp.capture(self, *self._acp_pos,
+                                          self._global_step)))
+
         cbks.on_begin("train")
         logs = {}
-        for epoch in range(start_epoch, epochs):
-            cbks.on_epoch_begin(epoch)
-            logs = self._run_one_epoch(train_loader, cbks, "train",
-                                       num_iters=num_iters,
-                                       accum=accumulate_grad_batches,
-                                       epoch=epoch,
-                                       skip_steps=skip_steps)
-            skip_steps = 0
-            cbks.on_epoch_end(epoch, logs)
-            if do_eval and epoch % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, callbacks=cbks,
-                                          _inside_fit=True)
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-            if self.stop_training:
-                break
+        with guard:
+            for epoch in range(start_epoch, epochs):
+                cbks.on_epoch_begin(epoch)
+                logs = self._run_one_epoch(train_loader, cbks, "train",
+                                           num_iters=num_iters,
+                                           accum=accumulate_grad_batches,
+                                           epoch=epoch,
+                                           skip_steps=skip_steps)
+                skip_steps = 0
+                cbks.on_epoch_end(epoch, logs)
+                if do_eval and epoch % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader, callbacks=cbks,
+                                              _inside_fit=True)
+                    logs.update({f"eval_{k}": v
+                                 for k, v in eval_logs.items()})
+                if self.stop_training:
+                    break
         if acp is not None:
             acp.wait()
         cbks.on_end("train", logs)
@@ -671,10 +683,13 @@ class Model:
             logs["batch_size"] = np.asarray(inputs[0]).shape[0]
             metric_logs = self._update_metrics(outs, labels)
             logs.update(metric_logs)
-            cbks.on_batch_end(mode, step, logs)
             if acp is not None and mode == "train":
+                # account the completed batch BEFORE callbacks: a SIGTERM
+                # raised from a callback must capture this step as done
                 self._global_step = getattr(self, "_global_step", 0) + 1
+                self._acp_pos = (epoch, step)
                 acp.maybe_save(self, epoch, step, self._global_step)
+            cbks.on_batch_end(mode, step, logs)
             if num_iters is not None and step + 1 >= num_iters:
                 break
         if self._lr_sched_step_on_epoch():

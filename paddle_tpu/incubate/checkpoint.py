@@ -23,7 +23,7 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["TrainingCheckpoint", "train_epoch_range"]
+__all__ = ["TrainingCheckpoint", "train_epoch_range", "PreemptionGuard"]
 
 
 def _np_tree(obj):
@@ -125,6 +125,50 @@ class TrainingCheckpoint:
         _rng.default_generator().seat(jnp.asarray(
             np.asarray(key, dtype=np.uint32)))
         return dict(state["counters"])
+
+
+class PreemptionGuard:
+    """SIGTERM-grace checkpointing (SURVEY §5.3: TPU preemptions send
+    SIGTERM before eviction; the reference's analog is the launcher's
+    watch loop + auto-checkpoint). While installed, SIGTERM triggers one
+    forced synchronous checkpoint before the default handler runs, so a
+    preempted job resumes from its exact step instead of the last
+    periodic save."""
+
+    def __init__(self, ckpt: TrainingCheckpoint, capture_fn):
+        """capture_fn() -> (step, state_dict) captured at signal time."""
+        self._ckpt = ckpt
+        self._capture = capture_fn
+        self._prev = None
+        self.fired = False
+
+    def __enter__(self):
+        import signal
+
+        def handler(signum, frame):
+            self.fired = True
+            try:
+                step, state = self._capture()
+                self._ckpt.save(step, state, force=True)
+                self._ckpt.wait()
+            finally:
+                if callable(self._prev):
+                    self._prev(signum, frame)
+                elif self._prev != signal.SIG_IGN:
+                    # grace save done: die by SIGTERM as the default
+                    # disposition would have, so the launcher sees the
+                    # true wait status
+                    import os
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+        self._prev = signal.signal(signal.SIGTERM, handler)
+        return self
+
+    def __exit__(self, *exc):
+        import signal
+        signal.signal(signal.SIGTERM, self._prev or signal.SIG_DFL)
+        return False
 
 
 def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,

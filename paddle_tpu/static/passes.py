@@ -1,0 +1,120 @@
+"""Program pass infrastructure.
+
+Analog of reference framework/ir/ (ir/pass.h Pass::Apply, ~50 registered
+passes, graph_viz_pass.cc). Design delta (SURVEY §7.1): operator fusion
+belongs to XLA here, so the pass tier owns what the compiler can't see —
+whole-Program surgery (dead-op elimination against fetch/persist targets)
+and debuggability (DOT dumps, the multi_devices_graph_print_pass analog).
+Passes run on the flat SSA op list; registration mirrors ir::PassRegistry.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from .program import Program, _Ref
+
+__all__ = ["Pass", "register_pass", "get_pass", "apply_pass",
+           "eliminate_dead_ops", "graph_viz"]
+
+_PASS_REGISTRY: Dict[str, Callable] = {}
+
+
+class Pass:
+    """Base pass (reference ir/pass.h). Subclass and implement apply()."""
+
+    name = "pass"
+
+    def apply(self, program: Program) -> Program:
+        raise NotImplementedError
+
+    def __call__(self, program):
+        return self.apply(program)
+
+
+def register_pass(name):
+    def deco(fn):
+        _PASS_REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+def get_pass(name):
+    if name not in _PASS_REGISTRY:
+        raise KeyError(f"no pass named {name!r}; have "
+                       f"{sorted(_PASS_REGISTRY)}")
+    return _PASS_REGISTRY[name]
+
+
+def apply_pass(program, names):
+    if isinstance(names, str):
+        names = [names]
+    for n in names:
+        program = get_pass(n)(program)
+    return program
+
+
+def _live_ids(program):
+    """Roots every op must ultimately feed: persistables, state writes,
+    backward/optimizer section variables, jit fetches."""
+    roots = set(program.persist_ids.values()) | set(
+        program.state_writes.values())
+    if program.backward_section is not None:
+        loss_var, pairs = program.backward_section
+        roots.add(loss_var.var_id)
+        for p, g in pairs:
+            roots.add(g.var_id)
+    for v in getattr(program, "_jit_fetch_vars", []) or []:
+        roots.add(v.var_id)
+    return roots
+
+
+@register_pass("eliminate_dead_ops")
+def eliminate_dead_ops(program, extra_live=()):
+    """Drop ops whose outputs reach no fetch/persist/backward root
+    (reference memory_optimize_pass/eager_deletion spirit at the
+    Program level). Returns a pruned clone; the original is untouched."""
+    live = _live_ids(program) | set(extra_live)
+    kept = []
+    for op in reversed(program.ops):
+        if any(oid in live for oid in op.out_ids):
+            kept.append(op)
+            for x in op.flat:
+                if isinstance(x, _Ref):
+                    live.add(x.var_id)
+    kept.reverse()
+    import copy
+    new = copy.copy(program)
+    new.ops = kept
+    new._version = getattr(program, "_version", 0) + 1
+    return new
+
+
+@register_pass("graph_viz")
+def graph_viz(program, path=None):
+    """DOT dump (reference ir/graph_viz_pass.cc). Returns the DOT text;
+    writes it when `path` is given. Ops are boxes, variables ellipses."""
+    lines = ["digraph program {", "  rankdir=TB;",
+             '  node [fontsize=10];']
+    var_names = {}
+    for name, v in list(program.data_vars.items()) \
+            + list(program.persistable_vars.items()):
+        var_names[v.var_id] = name
+    for i, op in enumerate(program.ops):
+        lines.append(f'  op{i} [shape=box,style=filled,fillcolor='
+                     f'lightgray,label="{op.name}"];')
+        for x in op.flat:
+            if isinstance(x, _Ref):
+                vid = x.var_id
+                label = var_names.get(vid, x.name or f"v{vid}")
+                lines.append(f'  v{vid} [shape=ellipse,label="{label}"];')
+                lines.append(f"  v{vid} -> op{i};")
+        for oid in op.out_ids:
+            lines.append(f'  v{oid} [shape=ellipse,label='
+                         f'"{var_names.get(oid, f"v{oid}")}"];')
+            lines.append(f"  op{i} -> v{oid};")
+    lines.append("}")
+    dot = "\n".join(lines)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

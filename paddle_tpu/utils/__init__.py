@@ -1,1 +1,2 @@
 from . import unique_name  # noqa: F401
+from .log_writer import LogWriter, read_scalars  # noqa: F401
