@@ -25,6 +25,7 @@ __all__ = ["data", "InputSpec", "Program", "Variable", "Executor",
            "program_guard", "name_scope", "default_main_program",
            "default_startup_program", "global_scope", "append_backward",
            "gradients", "save", "load", "set_program_state", "nn",
+           "save_inference_model", "load_inference_model",
            "cpu_places", "cuda_places"]
 
 
@@ -109,6 +110,87 @@ def load(program, path, executor=None, var_list=None):
     p = path + ".pdparams" if not path.endswith(".pdparams") else path
     state = _load(p, return_numpy=True)
     set_program_state(program, state)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None):
+    """Freeze the feed->fetch subgraph to a deployment artifact
+    (reference fluid/io.py:1198 save_inference_model). Emits:
+      - {prefix}.pdmodel   — pruned Program pickle (fine-tuning parity)
+      - {prefix}.pdiparams — persistable state
+      - {prefix}.stablehlo + {prefix}.pdinfer.json — serialized jax.export
+        module with parameters baked as constants, loadable by
+        paddle_tpu.inference.Predictor in a fresh process (the
+        OptimizeInferenceProgram pass pipeline collapses into XLA
+        compilation of this module).
+    """
+    import json
+    import pickle
+
+    import jax
+    import jax.export as jexport
+    import jax.numpy as jnp
+
+    from .passes import eliminate_dead_ops
+
+    if program is None:  # the graph the fetches live in, not the ambient
+        program = next((v.program for v in fetch_vars
+                        if getattr(v, "program", None) is not None),
+                       None) or default_main_program()
+    import copy
+    prog = copy.copy(program)
+    prog._jit_fetch_vars = list(fetch_vars)
+    pruned = eliminate_dead_ops(prog)
+
+    feed_names = [v.name for v in feed_vars]
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump({"program": pruned, "feed_names": feed_names}, f,
+                    protocol=4)
+    save(program, path_prefix + ".pdiparams")
+
+    # lower the pruned program once and export it with params baked in
+    entry = executor._compile(pruned, sorted(feed_names),
+                              [v.var_id for v in fetch_vars], False)
+    step, persist_names, _opt = entry
+    scope = global_scope()
+    scope_vals = {n: scope.get(n) for n in persist_names}
+    order = {n: i for i, n in enumerate(sorted(feed_names))}
+
+    def infer(*feeds):  # feeds arrive in feed_vars order
+        by_sorted = tuple(feeds[feed_names.index(n)]
+                          for n in sorted(feed_names))
+        fetches, _, _ = step(by_sorted, dict(scope_vals), {},
+                             jnp.zeros(()), jnp.zeros((), jnp.int32),
+                             jax.random.PRNGKey(0))
+        return tuple(fetches)
+
+    example = [jnp.zeros(v.aval.shape, v.aval.dtype) for v in feed_vars]
+    exported = jexport.export(jax.jit(infer), platforms=("cpu", "tpu"))(
+        *example)
+    with open(path_prefix + ".stablehlo", "wb") as f:
+        f.write(bytes(exported.serialize()))
+    meta = {
+        "input_names": feed_names,
+        "input_dtypes": [str(np.dtype(v.aval.dtype)) for v in feed_vars],
+        "output_names": [v.name for v in fetch_vars],
+        "format": "stablehlo+jax.export",
+    }
+    with open(path_prefix + ".pdinfer.json", "w") as f:
+        json.dump(meta, f)
+    return pruned
+
+
+def load_inference_model(path_prefix, executor=None):
+    """reference fluid/io.py load_inference_model: returns
+    [program, feed_names, fetch_vars]. (For the no-Python-model-class
+    deployment path use paddle_tpu.inference.Predictor instead.)"""
+    import pickle
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        payload = pickle.load(f)
+    program = payload["program"]
+    load(program, path_prefix + ".pdiparams")
+    return [program, payload["feed_names"],
+            list(getattr(program, "_jit_fetch_vars", []))]
 
 
 def cpu_places(device_count=None):

@@ -111,3 +111,49 @@ def test_incubate_functional_double_backward():
     h = IF.hessian(f)(x)
     np.testing.assert_allclose(np.asarray(h._value),
                                [[6.0, 0.0], [0.0, 12.0]], rtol=1e-6)
+
+
+def test_static_save_inference_model_round_trip(tmp_path):
+    """static.save_inference_model -> Predictor in a fresh process
+    (reference fluid/io.py:1198 + CreatePaddlePredictor)."""
+    import paddle_tpu.static as static
+    from paddle_tpu import ops, optimizer
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 6], "float32")
+            h = nn.Linear(6, 3)(x)
+            y = ops.softmax(h, axis=-1)
+        exe = static.Executor()
+        exe.run(startup)
+        xs = np.random.RandomState(0).randn(2, 6).astype("float32")
+        want = exe.run(main, feed={"x": xs}, fetch_list=[y])[0]
+        prefix = os.path.join(str(tmp_path), "static_m")
+        static.save_inference_model(prefix, [x], [y], exe)
+
+        # round trip through load_inference_model
+        prog2, feeds, fetches = static.load_inference_model(prefix)
+        got = exe.run(prog2, feed={"x": xs}, fetch_list=fetches)[0]
+        np.testing.assert_allclose(got, want, atol=1e-6)
+    finally:
+        paddle.disable_static()
+
+    # fresh process via the Predictor over the StableHLO artifact
+    opath = os.path.join(str(tmp_path), "o.npy")
+    xpath = os.path.join(str(tmp_path), "x.npy")
+    np.save(xpath, xs)
+    code = (
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from paddle_tpu.inference import Predictor\n"
+        f"out = Predictor({prefix!r}).run([np.load({xpath!r})])[0]\n"
+        f"np.save({opath!r}, out)\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          cwd="/root/repo", capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    np.testing.assert_allclose(np.load(opath), want, atol=1e-5)
