@@ -65,7 +65,8 @@ class MultiHeadAttention(Layer):
         x = ops.reshape(x, [b, s, self.num_heads, self.head_dim])
         return ops.transpose(x, [0, 2, 1, 3])
 
-    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None, is_causal=False):
         key = query if key is None else key
         value = query if value is None else value
         self_attn = key is query and value is query
@@ -90,7 +91,7 @@ class MultiHeadAttention(Layer):
         mask = _convert_attention_mask(attn_mask, q.dtype)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=mask, dropout_p=self.dropout,
-            training=self.training)
+            is_causal=is_causal, training=self.training)
         out = ops.transpose(out, [0, 2, 1, 3])
         b, s = out.shape[0], out.shape[1]
         out = ops.reshape(out, [b, s, self.embed_dim])

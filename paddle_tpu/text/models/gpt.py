@@ -37,9 +37,11 @@ class GPTBlock(nn.Layer):
         self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
         self.drop = nn.Dropout(cfg.dropout)
 
-    def forward(self, x, causal_mask):
+    def forward(self, x):
         h = self.ln1(x)
-        x = x + self.attn(h, attn_mask=causal_mask)
+        # is_causal (not a materialized [s,s] mask) keeps the Pallas flash
+        # kernel's in-kernel triangular masking + block skipping eligible
+        x = x + self.attn(h, is_causal=True)
         h = self.ln2(x)
         x = x + self.drop(self.fc2(F.gelu(self.fc1(h))))
         return x
@@ -59,13 +61,17 @@ class GPT(nn.Layer):
         from .bert import _bert_init
         _bert_init(self, std=0.02)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, labels=None):
         s = input_ids.shape[1]
         pos = ops.arange(s, dtype="int64")
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
-        mask = nn.Transformer.generate_square_subsequent_mask(s)
         for blk in self.blocks:
-            x = blk(x, mask)
+            x = blk(x)
         x = self.ln_f(x)
+        if labels is not None:
+            # fused tied-head LM loss: no [b*s, vocab] logits in HBM
+            # (ops/pallas/fused_ce.py), ignore_index=-100
+            return F.fused_linear_cross_entropy(
+                x, self.wte.weight, None, labels, ignore_index=-100)
         # weight-tied LM head
         return ops.matmul(x, self.wte.weight, transpose_y=True)
