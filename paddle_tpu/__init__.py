@@ -69,7 +69,8 @@ import importlib as _importlib
 _SUBMODULES = ("nn", "optimizer", "metric", "io", "amp", "static",
                "distributed", "vision", "jit", "hapi", "incubate",
                "profiler", "text", "sysconfig", "callbacks", "inference",
-               "framework", "regularizer", "memory", "quantization")
+               "framework", "regularizer", "memory", "quantization",
+               "distribution")
 
 
 def __getattr__(name):

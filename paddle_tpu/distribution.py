@@ -1,0 +1,245 @@
+"""Probability distributions.
+
+Analog of reference python/paddle/distribution.py (~v2.0-rc ships
+Distribution/Uniform/Normal/Categorical; later releases add the rest).
+Tensor-in/Tensor-out over the ambient PRNG chain (core/rng.py), sampling
+via jax.random so jitted steps get reproducible per-step keys.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import rng as _rng
+from .core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "kl_divergence"]
+
+
+def _raw(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap(v):
+    return Tensor(v, stop_gradient=True, _internal=True)
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_raw(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """reference distribution.py Normal."""
+
+    def __init__(self, loc, scale):
+        self.loc = _raw(loc).astype(jnp.float32)
+        self.scale = _raw(scale).astype(jnp.float32)
+
+    @property
+    def mean(self):
+        return _wrap(self.loc)
+
+    @property
+    def variance(self):
+        return _wrap(self.scale ** 2)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.loc.shape,
+                                                  self.scale.shape)
+        eps = jax.random.normal(_rng.next_key(), shp)
+        return _wrap(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        var = self.scale ** 2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var)
+                     - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _wrap(0.5 + 0.5 * math.log(2 * math.pi)
+                     + jnp.log(self.scale)
+                     + jnp.zeros_like(self.loc))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high):
+        self.low = _raw(low).astype(jnp.float32)
+        self.high = _raw(high).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.low.shape,
+                                                  self.high.shape)
+        u = jax.random.uniform(_rng.next_key(), shp)
+        return _wrap(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _raw(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _wrap(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None):
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits/probs")
+        if probs is not None:
+            p = _raw(probs).astype(jnp.float32)
+            self.logits = jnp.log(jnp.maximum(p, 1e-30))
+        else:
+            self.logits = _raw(logits).astype(jnp.float32)
+        self.logits = self.logits - jax.scipy.special.logsumexp(
+            self.logits, axis=-1, keepdims=True)
+
+    @property
+    def probs(self):
+        return _wrap(jnp.exp(self.logits))
+
+    def sample(self, shape=()):
+        return _wrap(jax.random.categorical(_rng.next_key(), self.logits,
+                                            shape=tuple(shape)
+                                            + self.logits.shape[:-1]))
+
+    def log_prob(self, value):
+        v = _raw(value).astype(jnp.int32)
+        return _wrap(jnp.take_along_axis(self.logits, v[..., None],
+                                         axis=-1)[..., 0])
+
+    def entropy(self):
+        p = jnp.exp(self.logits)
+        return _wrap(-jnp.sum(p * self.logits, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs):
+        self.probs_ = jnp.clip(_raw(probs).astype(jnp.float32), 1e-7,
+                               1 - 1e-7)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self.probs_.shape
+        return _wrap(jax.random.bernoulli(_rng.next_key(), self.probs_,
+                                          shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _raw(value)
+        return _wrap(v * jnp.log(self.probs_)
+                     + (1 - v) * jnp.log1p(-self.probs_))
+
+    def entropy(self):
+        p = self.probs_
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _raw(alpha).astype(jnp.float32)
+        self.beta = _raw(beta).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.alpha.shape,
+                                                  self.beta.shape)
+        return _wrap(jax.random.beta(_rng.next_key(), self.alpha,
+                                     self.beta, shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import betaln
+        v = _raw(value)
+        return _wrap((self.alpha - 1) * jnp.log(v)
+                     + (self.beta - 1) * jnp.log1p(-v)
+                     - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+        a, b = self.alpha, self.beta
+        return _wrap(betaln(a, b) - (a - 1) * digamma(a)
+                     - (b - 1) * digamma(b)
+                     + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _raw(concentration).astype(jnp.float32)
+
+    def sample(self, shape=()):
+        return _wrap(jax.random.dirichlet(_rng.next_key(),
+                                          self.concentration,
+                                          tuple(shape)
+                                          + self.concentration.shape[:-1]))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        a = self.concentration
+        v = _raw(value)
+        norm = jnp.sum(gammaln(a), -1) - gammaln(jnp.sum(a, -1))
+        return _wrap(jnp.sum((a - 1) * jnp.log(v), -1) - norm)
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        lnB = jnp.sum(gammaln(a), -1) - gammaln(a0)
+        return _wrap(lnB + (a0 - k) * digamma(a0)
+                     - jnp.sum((a - 1) * digamma(a), -1))
+
+
+def kl_divergence(p, q):
+    """Closed-form KL for matching families (reference
+    paddle.distribution.kl_divergence registry)."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        vr = (p.scale / q.scale) ** 2
+        return _wrap(0.5 * (vr + ((p.loc - q.loc) / q.scale) ** 2
+                            - 1 - jnp.log(vr)))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        pp = jnp.exp(p.logits)
+        return _wrap(jnp.sum(pp * (p.logits - q.logits), axis=-1))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        a, b = p.probs_, q.probs_
+        return _wrap(a * (jnp.log(a) - jnp.log(b))
+                     + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        return _wrap(jnp.log((q.high - q.low) / (p.high - p.low)))
+    if isinstance(p, Beta) and isinstance(q, Beta):
+        from jax.scipy.special import betaln, digamma
+        a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+        return _wrap(betaln(a2, b2) - betaln(a1, b1)
+                     + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                     + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+    if isinstance(p, Dirichlet) and isinstance(q, Dirichlet):
+        from jax.scipy.special import digamma, gammaln
+        a, b = p.concentration, q.concentration
+        a0 = jnp.sum(a, -1, keepdims=True)
+        t1 = gammaln(jnp.sum(a, -1)) - gammaln(jnp.sum(b, -1))
+        t2 = jnp.sum(gammaln(b) - gammaln(a), -1)
+        t3 = jnp.sum((a - b) * (digamma(a) - digamma(a0)), -1)
+        return _wrap(t1 + t2 + t3)
+    raise NotImplementedError(
+        f"no closed-form KL for {type(p).__name__} vs {type(q).__name__}")

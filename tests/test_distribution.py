@@ -1,0 +1,70 @@
+"""paddle.distribution vs scipy references."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Bernoulli, Beta, Categorical,
+                                     Dirichlet, Normal, Uniform,
+                                     kl_divergence)
+
+
+def test_normal():
+    d = Normal(1.0, 2.0)
+    x = np.array([0.0, 1.0, 3.0], "float32")
+    np.testing.assert_allclose(d.log_prob(x).numpy(),
+                               st.norm(1.0, 2.0).logpdf(x), rtol=1e-5)
+    np.testing.assert_allclose(float(d.entropy().numpy()),
+                               st.norm(1.0, 2.0).entropy(), rtol=1e-6)
+    s = d.sample([20000]).numpy()
+    assert abs(s.mean() - 1.0) < 0.08 and abs(s.std() - 2.0) < 0.1
+
+
+def test_uniform_categorical_bernoulli():
+    u = Uniform(0.0, 4.0)
+    assert abs(float(u.entropy().numpy()) - np.log(4.0)) < 1e-6
+    assert float(u.log_prob(np.float32(5.0)).numpy()) == -np.inf
+
+    c = Categorical(probs=np.array([0.2, 0.3, 0.5], "float32"))
+    np.testing.assert_allclose(c.entropy().numpy(),
+                               st.entropy([0.2, 0.3, 0.5]), rtol=1e-5)
+    s = c.sample([20000]).numpy()
+    np.testing.assert_allclose(np.bincount(s) / 20000, [0.2, 0.3, 0.5],
+                               atol=0.03)
+
+    b = Bernoulli(np.float32(0.3))
+    np.testing.assert_allclose(float(b.log_prob(np.float32(1.0)).numpy()),
+                               np.log(0.3), rtol=1e-5)
+
+
+def test_beta_dirichlet():
+    d = Beta(2.0, 5.0)
+    x = np.array([0.1, 0.4], "float32")
+    np.testing.assert_allclose(d.log_prob(x).numpy(),
+                               st.beta(2, 5).logpdf(x), rtol=1e-4)
+    np.testing.assert_allclose(float(d.entropy().numpy()),
+                               st.beta(2, 5).entropy(), rtol=1e-4)
+    dd = Dirichlet(np.array([2.0, 3.0, 4.0], "float32"))
+    v = np.array([0.2, 0.3, 0.5], "float32")
+    np.testing.assert_allclose(float(dd.log_prob(v).numpy()),
+                               st.dirichlet([2, 3, 4]).logpdf(v), rtol=1e-4)
+
+
+def test_kl_closed_forms():
+    p, q = Normal(0.0, 1.0), Normal(1.0, 2.0)
+    mc = p.sample([200000]).numpy()
+    kl_mc = (st.norm(0, 1).logpdf(mc) - st.norm(1, 2).logpdf(mc)).mean()
+    np.testing.assert_allclose(float(kl_divergence(p, q).numpy()), kl_mc,
+                               atol=0.02)
+    c1 = Categorical(probs=np.array([0.5, 0.5], "float32"))
+    c2 = Categorical(probs=np.array([0.9, 0.1], "float32"))
+    want = 0.5 * np.log(0.5 / 0.9) + 0.5 * np.log(0.5 / 0.1)
+    np.testing.assert_allclose(float(kl_divergence(c1, c2).numpy()), want,
+                               rtol=1e-5)
+    b1, b2 = Beta(2.0, 3.0), Beta(4.0, 1.5)
+    s = b1.sample([200000]).numpy()
+    kl_mc = (st.beta(2, 3).logpdf(s) - st.beta(4, 1.5).logpdf(s)).mean()
+    np.testing.assert_allclose(float(kl_divergence(b1, b2).numpy()), kl_mc,
+                               atol=0.03)
+    with pytest.raises(NotImplementedError):
+        kl_divergence(b1, c1)
