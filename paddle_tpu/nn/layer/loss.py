@@ -7,7 +7,25 @@ from .layers import Layer
 __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "HuberLoss",
            "MarginRankingLoss", "HingeEmbeddingLoss", "TripletMarginLoss",
-           "CosineEmbeddingLoss"]
+           "CosineEmbeddingLoss", "CTCLoss"]
+
+
+class CTCLoss(Layer):
+    """reference paddle.nn.CTCLoss over operators/warpctc_op.cc (here a
+    lax.scan alpha recursion, ops/loss.py ctc_loss)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        from ... import ops
+        return ops.ctc_loss(log_probs, labels, input_lengths,
+                            label_lengths, blank=self.blank,
+                            reduction=self.reduction,
+                            norm_by_times=norm_by_times)
 
 
 class CrossEntropyLoss(Layer):

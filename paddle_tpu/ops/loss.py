@@ -198,3 +198,77 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A
     dn = jnp.power(jnp.sum(jnp.power(jnp.abs(input - negative) + epsilon, p),
                            axis=-1), 1.0 / p)
     return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+@defop
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference operators/warpctc_op.cc wrapping Baidu warpctc).
+
+    TPU-native design: warpctc's hand-written CPU/GPU alpha-beta kernels
+    become a log-space alpha recursion under lax.scan over the extended
+    (blank-interleaved) label sequence — fully differentiable by jax AD,
+    so no hand-written beta/grad kernel is needed, and the whole loss
+    jits into the training step.
+
+    log_probs: [T, B, C] logits (softmax applied internally, matching
+    warpctc); labels: [B, S] int; input_lengths/label_lengths: [B].
+    """
+    from jax.scipy.special import logsumexp
+
+    lp = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    T, B, C = lp.shape
+    S = labels.shape[1]
+    L = 2 * S + 1
+    NEG = -1e30
+    labels = labels.astype(jnp.int32)
+    input_lengths = input_lengths.astype(jnp.int32)
+    label_lengths = label_lengths.astype(jnp.int32)
+
+    ext = jnp.full((B, L), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)                       # [B, L]
+    # skip transition allowed into odd (label) positions whose label
+    # differs from the one two slots back
+    skip_ok = jnp.concatenate(
+        [jnp.zeros((B, 2), bool), ext[:, 2:] != ext[:, :-2]], axis=1)
+
+    emit0 = jnp.take_along_axis(lp[0], ext, axis=1)         # [B, L]
+    alpha0 = jnp.full((B, L), NEG)
+    alpha0 = alpha0.at[:, 0].set(emit0[:, 0])
+    if S > 0:
+        has_label = (label_lengths > 0)
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(has_label, emit0[:, 1], NEG))
+
+    def step(alpha, inp):
+        lp_t, t = inp
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(skip_ok, prev2, NEG)
+        merged = logsumexp(jnp.stack([alpha, prev1, prev2]), axis=0)
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)
+        new = merged + emit
+        active = (t < input_lengths)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0,
+                            (lp[1:], jnp.arange(1, T, dtype=jnp.int32)))
+
+    idx_last = (2 * label_lengths)[:, None]                 # final blank
+    idx_prev = jnp.maximum(idx_last - 1, 0)                 # final label
+    a_last = jnp.take_along_axis(alpha, idx_last, axis=1)[:, 0]
+    a_prev = jnp.where(
+        label_lengths > 0,
+        jnp.take_along_axis(alpha, idx_prev, axis=1)[:, 0], NEG)
+    ll = logsumexp(jnp.stack([a_last, a_prev]), axis=0)     # [B]
+    loss = -ll
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths.astype(jnp.float32), 1.0)
+    if reduction == "mean":
+        # warpctc 'mean': per-sample loss over its label length, then
+        # batch average (paddle.nn.CTCLoss and torch agree)
+        return jnp.mean(
+            loss / jnp.maximum(label_lengths.astype(jnp.float32), 1.0))
+    return _reduce(loss, reduction)

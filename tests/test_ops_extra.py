@@ -203,3 +203,49 @@ def test_grad_check_selected_extras():
     g_num = numeric_grad(ops.gammaln, [x], 0)
     np.testing.assert_allclose(np.asarray(g_an.grad._value), g_num,
                                rtol=5e-3, atol=1e-3)
+
+
+def test_ctc_loss_matches_torch():
+    """Golden test vs torch.nn.functional.ctc_loss (CPU torch is the
+    reference implementation of the same warpctc semantics), values AND
+    gradients, with variable input/label lengths."""
+    import torch
+    import torch.nn.functional as tF
+
+    rng = np.random.RandomState(0)
+    Tm, B, C, S = 12, 3, 5, 4
+    logits = rng.randn(Tm, B, C).astype("float32")
+    labels = rng.randint(1, C, (B, S)).astype("int64")  # no blanks inside
+    in_lens = np.array([12, 9, 7], "int64")
+    lab_lens = np.array([4, 3, 1], "int64")
+
+    # torch reference (expects log_probs)
+    t_logits = torch.tensor(logits, requires_grad=True)
+    t_lp = tF.log_softmax(t_logits, dim=-1)
+    t_loss = tF.ctc_loss(t_lp, torch.tensor(labels),
+                         torch.tensor(in_lens), torch.tensor(lab_lens),
+                         blank=0, reduction="mean", zero_infinity=False)
+    t_loss.backward()
+
+    x = paddle.to_tensor(logits)
+    x.stop_gradient = False
+    loss = ops.ctc_loss(x, paddle.to_tensor(labels),
+                        paddle.to_tensor(in_lens),
+                        paddle.to_tensor(lab_lens), blank=0,
+                        reduction="mean")
+    np.testing.assert_allclose(float(loss.numpy()), float(t_loss),
+                               rtol=1e-4)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               t_logits.grad.numpy(), atol=2e-4)
+
+    # torch 'mean' divides per-sample by label_length then averages; also
+    # check the sum reduction path and the layer wrapper
+    from paddle_tpu import nn as pnn
+    layer = pnn.CTCLoss(blank=0, reduction="sum")
+    l2 = layer(paddle.to_tensor(logits), paddle.to_tensor(labels),
+               paddle.to_tensor(in_lens), paddle.to_tensor(lab_lens))
+    t_sum = tF.ctc_loss(tF.log_softmax(torch.tensor(logits), -1),
+                        torch.tensor(labels), torch.tensor(in_lens),
+                        torch.tensor(lab_lens), blank=0, reduction="sum")
+    np.testing.assert_allclose(float(l2.numpy()), float(t_sum), rtol=1e-4)
