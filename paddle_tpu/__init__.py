@@ -82,6 +82,10 @@ def __getattr__(name):
         from .hapi import Model
         globals()["Model"] = Model
         return Model
+    if name in ("summary", "flops"):
+        from .hapi.summary import flops, summary
+        globals().update(summary=summary, flops=flops)
+        return globals()[name]
     if name in ("save", "load"):
         from .framework.io import load, save
         globals().update(save=save, load=load)

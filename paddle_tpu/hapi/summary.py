@@ -1,0 +1,121 @@
+"""Model introspection: paddle.summary and paddle.flops.
+
+Analog of reference python/paddle/hapi/model_summary.py (layer table via
+forward hooks) and hapi/dynamic_flops.py (per-layer flop counting with a
+hand-maintained formula registry). Design delta for flops: XLA's cost
+analysis of the compiled forward is exact and covers every op, so the
+formula registry disappears (profiler.cost_analysis).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary", "flops"]
+
+
+def _example_inputs(input_size, dtypes):
+    import jax.numpy as jnp
+    if isinstance(input_size, tuple) and input_size and \
+            isinstance(input_size[0], (tuple, list)):
+        sizes = list(input_size)
+    else:
+        sizes = [input_size]
+    dtypes = dtypes or ["float32"] * len(sizes)
+    from ..core.dtype import to_jax_dtype
+    out = []
+    for s, dt in zip(sizes, dtypes):
+        shape = [1 if (d is None or d == -1) else int(d) for d in s]
+        jd = to_jax_dtype(dt)
+        if jnp.issubdtype(jd, jnp.integer):
+            out.append(jnp.zeros(shape, jd))
+        else:
+            out.append(jnp.ones(shape, jd))
+    return out
+
+
+def summary(net, input_size, dtypes=None):
+    """Layer-by-layer table: output shapes + parameter counts (reference
+    hapi/model_summary.py summary). Returns {'total_params': ...,
+    'trainable_params': ...}."""
+    from ..core import tape as _tape
+    from ..core.tensor import Tensor
+
+    rows = []
+    hooks = []
+
+    def mk_hook(name, layer):
+        def hook(lyr, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) \
+                else outputs
+            shape = list(out.shape) if hasattr(out, "shape") else []
+            n_params = sum(int(np.prod(p.shape))
+                           for p in lyr._parameters.values()
+                           if p is not None)
+            rows.append((f"{type(lyr).__name__}-{name}", shape, n_params))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        hooks.append(sub.register_forward_post_hook(mk_hook(name, sub)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        with _tape.no_grad():
+            x = [Tensor(v, _internal=True)
+                 for v in _example_inputs(input_size, dtypes)]
+            net(*x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    w = max([len(r[0]) for r in rows] + [14]) + 2
+    lines = [f"{'Layer (type)':<{w}}{'Output Shape':<22}{'Param #':<12}",
+             "-" * (w + 34)]
+    for name, shape, n in rows:
+        lines.append(f"{name:<{w}}{str(shape):<22}{n:<12,}")
+    lines.append("-" * (w + 34))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total - trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, dtypes=None, print_detail=False):
+    """FLOPs of one eval forward (reference hapi/dynamic_flops.py). Exact:
+    XLA cost analysis of the compiled forward, no per-layer formulas."""
+    import jax
+
+    from .. import profiler
+    from ..core import tape as _tape
+    from ..core import rng as _rng
+    from ..core.tensor import Tensor
+
+    params, buffers = net.functional_state()
+    was_training = net.training
+    net.eval()
+    try:
+        def fwd(p, *xs):
+            with _tape.no_grad(), _rng.rng_state(jax.random.PRNGKey(0)):
+                net.load_functional_state(p, buffers)
+                out = net(*[Tensor(x, _internal=True) for x in xs])
+            leaves = jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+            return leaves
+
+        example = _example_inputs(input_size, dtypes)
+        ca = profiler.cost_analysis(jax.jit(fwd), params, *example)
+        total = int(float(ca.get("flops", 0.0)))
+    finally:
+        if was_training:
+            net.train()
+    if print_detail:
+        print(f"Total FLOPs: {total:,}  (bytes accessed: "
+              f"{int(float(ca.get('bytes accessed', 0))):,})")
+    return total

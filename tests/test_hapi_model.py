@@ -120,3 +120,15 @@ def test_model_summary(capsys):
     model = Model(LeNet())
     info = model.summary()
     assert info["total_params"] == 61610
+
+
+def test_summary_and_flops():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    info = paddle.summary(net, (1, 8))
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 4 + 4
+    assert info["trainable_params"] == info["total_params"]
+    f = paddle.flops(net, (1, 8))
+    # two matmuls dominate: 2*(8*16) + 2*(16*4) flops per sample
+    assert f >= 2 * 8 * 16 + 2 * 16 * 4
+    assert f < 10000
