@@ -768,16 +768,25 @@ class Model:
         return self
 
     def summary(self, input_size=None, dtype=None):
+        if input_size is not None:
+            # full layer table with output shapes (hapi/summary.py — the
+            # single implementation behind paddle.summary too)
+            from .summary import summary as _summary
+            return _summary(self.network, input_size,
+                            dtypes=[dtype] if dtype else None)
         rows = []
-        total = 0
+        total = trainable = 0
         for name, p in self.network.named_parameters():
             rows.append((name, p.shape, p.size))
             total += p.size
+            if not p.stop_gradient:
+                trainable += p.size
         width = max((len(r[0]) for r in rows), default=10) + 2
         lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Params':<12}"]
         for name, shape, size in rows:
             lines.append(f"{name:<{width}}{str(list(shape)):<20}{size:<12}")
         lines.append(f"Total params: {total:,}")
+        lines.append(f"Trainable params: {trainable:,}")
         text = "\n".join(lines)
         print(text)
-        return {"total_params": total}
+        return {"total_params": total, "trainable_params": trainable}

@@ -15,22 +15,43 @@ __all__ = ["summary", "flops"]
 
 def _example_inputs(input_size, dtypes):
     import jax.numpy as jnp
-    if isinstance(input_size, tuple) and input_size and \
-            isinstance(input_size[0], (tuple, list)):
-        sizes = list(input_size)
+    from .model import InputSpec
+
+    def norm(one):
+        if isinstance(one, InputSpec):
+            return list(one.shape), str(one.dtype)
+        return list(one), None
+
+    if isinstance(input_size, InputSpec):
+        sizes = [norm(input_size)]
+    elif isinstance(input_size, (tuple, list)) and input_size and \
+            isinstance(input_size[0], (tuple, list, InputSpec)):
+        sizes = [norm(s) for s in input_size]
     else:
-        sizes = [input_size]
-    dtypes = dtypes or ["float32"] * len(sizes)
+        sizes = [norm(input_size)]
+    dtypes = dtypes or [None] * len(sizes)
     from ..core.dtype import to_jax_dtype
     out = []
-    for s, dt in zip(sizes, dtypes):
-        shape = [1 if (d is None or d == -1) else int(d) for d in s]
-        jd = to_jax_dtype(dt)
+    for (shape, spec_dt), dt in zip(sizes, dtypes):
+        shape = [1 if (d is None or d == -1) else int(d) for d in shape]
+        jd = to_jax_dtype(dt or spec_dt or "float32")
         if jnp.issubdtype(jd, jnp.integer):
             out.append(jnp.zeros(shape, jd))
         else:
             out.append(jnp.ones(shape, jd))
     return out
+
+
+def _snapshot_modes(net):
+    return [(sub, sub.training) for _, sub in
+            net.named_sublayers(include_self=True)]
+
+
+def _restore_modes(snapshot):
+    # reapply per-sublayer flags: a blanket net.train() would clobber
+    # deliberately-frozen sublayers (e.g. eval-mode BN during fine-tuning)
+    for sub, flag in snapshot:
+        sub.training = flag
 
 
 def summary(net, input_size, dtypes=None):
@@ -57,7 +78,7 @@ def summary(net, input_size, dtypes=None):
     for name, sub in net.named_sublayers():
         hooks.append(sub.register_forward_post_hook(mk_hook(name, sub)))
 
-    was_training = net.training
+    modes = _snapshot_modes(net)
     net.eval()
     try:
         with _tape.no_grad():
@@ -67,8 +88,7 @@ def summary(net, input_size, dtypes=None):
     finally:
         for h in hooks:
             h.remove()
-        if was_training:
-            net.train()
+        _restore_modes(modes)
 
     total = sum(int(np.prod(p.shape)) for p in net.parameters())
     trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
@@ -97,7 +117,7 @@ def flops(net, input_size, dtypes=None, print_detail=False):
     from ..core.tensor import Tensor
 
     params, buffers = net.functional_state()
-    was_training = net.training
+    modes = _snapshot_modes(net)
     net.eval()
     try:
         def fwd(p, *xs):
@@ -113,8 +133,11 @@ def flops(net, input_size, dtypes=None, print_detail=False):
         ca = profiler.cost_analysis(jax.jit(fwd), params, *example)
         total = int(float(ca.get("flops", 0.0)))
     finally:
-        if was_training:
-            net.train()
+        # the trace seated tracers into the layer via load_functional_state;
+        # put the concrete values back (same contract as the hapi engine's
+        # _restore) or the next forward reads leaked tracers
+        net.load_functional_state(params, buffers)
+        _restore_modes(modes)
     if print_detail:
         print(f"Total FLOPs: {total:,}  (bytes accessed: "
               f"{int(float(ca.get('bytes accessed', 0))):,})")

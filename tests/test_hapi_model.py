@@ -132,3 +132,24 @@ def test_summary_and_flops():
     # two matmuls dominate: 2*(8*16) + 2*(16*4) flops per sample
     assert f >= 2 * 8 * 16 + 2 * 16 * 4
     assert f < 10000
+
+
+def test_flops_leaves_net_usable_and_modes_intact():
+    """Regression: flops() traces through the layer — afterwards the real
+    params must be reseated (no leaked tracers) and per-sublayer
+    train/eval flags preserved."""
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+    net.train()
+    net[1].eval()  # deliberately frozen BN
+    paddle.flops(net, (2, 4))
+    assert net.training and not net[1].training  # modes preserved
+    out = net(paddle.to_tensor(np.ones((2, 4), "float32")))  # no tracers
+    assert np.isfinite(np.asarray(out._value)).all()
+    # multi-input and InputSpec forms
+    from paddle_tpu.hapi.model import InputSpec
+    info = paddle.summary(net, InputSpec([None, 4], "float32"))
+    assert info["total_params"] > 0
+    m = Model(net)
+    info2 = m.summary((2, 4))
+    assert info2["total_params"] == info["total_params"]
