@@ -1,4 +1,4 @@
 from . import lr  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
-from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Lars,  # noqa: F401
-                        Momentum, Optimizer, RMSProp, SGD)
+from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Dpsgd,  # noqa: F401
+                        Ftrl, Lamb, Lars, Momentum, Optimizer, RMSProp, SGD)

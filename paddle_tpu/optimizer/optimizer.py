@@ -28,7 +28,8 @@ from .clip import ClipGradBase
 from ..regularizer import L1Decay, L2Decay
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars"]
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "Lars", "Ftrl",
+           "Dpsgd"]
 
 
 class Optimizer:
@@ -581,3 +582,65 @@ class Lars(Momentum):
         v = self._momentum * slots["velocity"] + lr * local_lr * eff
         new_p = (p32 - v).astype(p.dtype)
         return new_p, {"velocity": v}
+
+
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference: operators/optimizers/ftrl_op.cc —
+    squared/linear accumulators, l1/l2 regularization, lr_power)."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _init_slots_for(self, name, v):
+        return {"squared": self._slot_like(v), "linear": self._slot_like(v)}
+
+    def _rule(self, p, g, slots, lr, t):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        n = slots["squared"]
+        z = slots["linear"]
+        new_n = n + jnp.square(g32)
+        lp = -self._lr_power  # 0.5 for the default
+        sigma = (jnp.power(new_n, lp) - jnp.power(n, lp)) / lr
+        new_z = z + g32 - sigma * p32
+        denom = jnp.power(new_n, lp) / lr + 2 * self._l2
+        new_p = jnp.where(
+            jnp.abs(new_z) > self._l1,
+            (jnp.sign(new_z) * self._l1 - new_z) / denom, 0.0)
+        return new_p.astype(p.dtype), {"squared": new_n, "linear": new_z}
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (reference:
+    operators/optimizers/dpsgd_op.cc — per-update gradient norm clipping
+    plus calibrated gaussian noise). Noise is drawn from a key derived
+    deterministically from (seed, step), so the update stays a pure
+    jittable function."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16.0,
+                 sigma=1.0, parameters=None, seed=0, name=None):
+        super().__init__(learning_rate, parameters, None, None, name)
+        self._clip = clip
+        self._batch = batch_size
+        self._sigma = sigma
+        self._seed = seed
+
+    def _rule(self, p, g, slots, lr, t):
+        import jax as _jax
+        g32 = g.astype(jnp.float32)
+        norm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+        g32 = g32 / jnp.maximum(1.0, norm / self._clip)
+        key = _jax.random.fold_in(
+            _jax.random.fold_in(_jax.random.PRNGKey(self._seed),
+                                t.astype(jnp.uint32)),
+            jnp.uint32(abs(hash(str(p.shape))) % (2 ** 31)))
+        noise = self._sigma * self._clip / self._batch \
+            * _jax.random.normal(key, p.shape)
+        new_p = (p.astype(jnp.float32) - lr * (g32 + noise)).astype(p.dtype)
+        return new_p, {}

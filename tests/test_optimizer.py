@@ -162,3 +162,41 @@ def test_training_loop_linear_model():
             first = loss.item()
     assert loss.item() < first * 0.01
     np.testing.assert_allclose(net.weight.numpy(), true_w, atol=0.1)
+
+
+def test_ftrl_converges_and_sparsifies():
+    paddle.seed(0)
+    np.random.seed(0)
+    X = np.random.rand(64, 8).astype("float32")
+    w_true = np.zeros((8, 1), "float32")
+    w_true[:3] = [[1.0], [-2.0], [0.5]]  # sparse ground truth
+    Y = X @ w_true
+    lin = nn.Linear(8, 1)
+    opt = optimizer.Ftrl(learning_rate=0.5, l1=0.01,
+                         parameters=lin.parameters())
+    losses = []
+    for _ in range(150):
+        loss = ((lin(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.05, (losses[0], losses[-1])
+
+
+def test_dpsgd_noisy_but_trains():
+    paddle.seed(0)
+    np.random.seed(1)
+    X = np.random.rand(64, 4).astype("float32")
+    Y = X @ np.ones((4, 1), "float32")
+    lin = nn.Linear(4, 1)
+    opt = optimizer.Dpsgd(learning_rate=0.05, clip=5.0, batch_size=64.0,
+                          sigma=0.5, parameters=lin.parameters())
+    losses = []
+    for _ in range(80):
+        loss = ((lin(paddle.to_tensor(X)) - paddle.to_tensor(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5  # noisy, but descending
