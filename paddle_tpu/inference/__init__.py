@@ -20,7 +20,20 @@ import os
 
 import numpy as np
 
-__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
+           "encrypt_model"]
+
+
+def encrypt_model(prefix, key):
+    """Encrypt the weight-bearing artifact at rest (reference model
+    encryption, framework/io/crypto + mkldnn_quantizer-adjacent deploy
+    flow): {prefix}.stablehlo -> {prefix}.stablehlo.enc (AES-256-GCM),
+    plaintext removed. Metadata (names/shapes only) stays readable."""
+    from ..framework.crypto import Cipher
+    c = Cipher(key)
+    src = prefix + ".stablehlo"
+    c.encrypt_file(src, src + ".enc")
+    os.remove(src)
 
 
 class Config:
@@ -31,10 +44,16 @@ class Config:
         # accept either a path prefix ("model" for model.stablehlo /
         # model.pdmodel) or explicit file paths
         self._prefix = None
+        self._cipher_key = None
         if model_path is not None:
             self.set_model(model_path, params_path)
         self._ir_optim = True
         self._glog_info = True
+
+    def set_cipher_key(self, key: bytes):
+        """Key for encrypted artifacts (reference analysis_config crypto
+        flow over framework/io/crypto)."""
+        self._cipher_key = key
 
     def set_model(self, model_path, params_path=None):
         for suffix in (".stablehlo", ".pdmodel", ".pdinfer.json"):
@@ -108,7 +127,17 @@ class Predictor:
         hlo_path = prefix + ".stablehlo"
         self._exported = None
         self._translated = None
-        if os.path.exists(hlo_path):
+        key = getattr(config, "_cipher_key", None)
+        if os.path.exists(hlo_path + ".enc"):
+            if key is None:
+                raise PermissionError(
+                    f"{hlo_path}.enc is encrypted; pass the key via "
+                    "Config.set_cipher_key")
+            import jax.export
+            from ..framework.crypto import Cipher
+            blob = Cipher(key).decrypt_from_file(hlo_path + ".enc")
+            self._exported = jax.export.deserialize(bytearray(blob))
+        elif os.path.exists(hlo_path):
             import jax.export
             with open(hlo_path, "rb") as f:
                 self._exported = jax.export.deserialize(

@@ -157,3 +157,61 @@ def test_static_save_inference_model_round_trip(tmp_path):
                           timeout=300)
     assert proc.returncode == 0, proc.stderr[-2000:]
     np.testing.assert_allclose(np.load(opath), want, atol=1e-5)
+
+
+def test_encrypted_model_round_trip(tmp_path):
+    """N35 analog: AES-256-GCM model encryption at rest; wrong/missing key
+    fails loudly, right key reproduces outputs."""
+    from paddle_tpu.framework.crypto import Cipher, CipherUtils
+    from paddle_tpu.inference import Config, Predictor, encrypt_model
+
+    net, prefix = _save_model(tmp_path)
+    x = np.random.RandomState(3).randn(2, 8).astype("float32")
+    want = np.asarray(net(paddle.to_tensor(x))._value)
+
+    key = CipherUtils.gen_key_to_file(os.path.join(str(tmp_path), "k"))
+    encrypt_model(prefix, key)
+    assert not os.path.exists(prefix + ".stablehlo")
+    assert os.path.exists(prefix + ".stablehlo.enc")
+
+    with pytest.raises(PermissionError, match="encrypted"):
+        Predictor(prefix)  # no key -> loud
+
+    cfg = Config(prefix)
+    cfg.set_cipher_key(key)
+    got = Predictor(cfg).run([x])[0]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    bad = Config(prefix)
+    bad.set_cipher_key(CipherUtils.gen_key())
+    with pytest.raises(Exception):  # authentication failure
+        Predictor(bad)
+
+    # raw cipher surface
+    c = Cipher(key)
+    blob = c.encrypt(b"secret weights")
+    assert c.decrypt(blob) == b"secret weights"
+    with pytest.raises(Exception):
+        c.decrypt(blob[:-1] + bytes([blob[-1] ^ 1]))  # tamper detected
+
+
+def test_resnet18_trains_tiny():
+    """BASELINE config 2 representative: ResNet forward/backward/step."""
+    from paddle_tpu.vision.models import resnet18
+    from paddle_tpu import optimizer
+    paddle.seed(0)
+    net = resnet18(num_classes=4)
+    opt = optimizer.Momentum(learning_rate=0.01,
+                             parameters=net.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).rand(
+        2, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(np.array([0, 3], "int64"))
+    ce = nn.CrossEntropyLoss()
+    losses = []
+    for _ in range(3):
+        loss = ce(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
