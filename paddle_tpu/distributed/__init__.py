@@ -11,7 +11,8 @@ from .mesh import get_mesh, init_mesh, mesh_axis_size, in_spmd_region  # noqa: F
 import importlib as _importlib
 
 _LAZY_MODULES = ("fleet", "sharding", "pipeline", "launch", "spawn", "moe",
-                 "collective", "parallel", "ring_attention", "bootstrap")
+                 "collective", "parallel", "ring_attention", "bootstrap",
+                 "elastic")
 _LAZY_NAMES = {
     "recompute": "recompute", "checkpoint_policy": "recompute",
     "all_gather": "collective", "all_reduce": "collective",

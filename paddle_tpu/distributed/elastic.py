@@ -1,0 +1,119 @@
+"""Failure detection and elastic restart.
+
+Analog of the reference's liveness machinery: HeartBeatMonitor
+(operators/distributed/heart_beat_monitor.cc — tracks worker heartbeats,
+completes barriers when workers die), the launcher watch loop
+(distributed/utils.py:424), and the `DistributedStrategy.elastic` knob
+(a stub in the reference snapshot, fleet/base/distributed_strategy.py:1160).
+
+TPU-native scoping (SURVEY §5.3): collective jobs can't paper over a lost
+process mid-step — recovery is restart-from-checkpoint, which
+incubate/checkpoint.py makes exact. What belongs HERE is detection and
+supervision: a heartbeat any watcher can read, a stall monitor that fires
+a callback when training stops progressing (hung collective, dead input
+pipeline), and launcher-side restart of failed trainers
+(distributed/launch.py --elastic), which resume via auto-checkpoint.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["Heartbeat", "StallMonitor"]
+
+
+class Heartbeat:
+    """Periodic liveness file: {dir}/heartbeat_{rank}.json holding rank,
+    step, timestamp (the HeartBeatMonitor's UPDATE side; any supervisor —
+    the launcher, an operator, a dashboard — is the CHECK side)."""
+
+    def __init__(self, directory, rank=None, interval_s=10.0):
+        from .env import get_rank
+        os.makedirs(directory, exist_ok=True)
+        self.rank = get_rank() if rank is None else rank
+        self.path = os.path.join(directory, f"heartbeat_{self.rank}.json")
+        self.interval_s = interval_s
+        self._step = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def update(self, step=None):
+        if step is not None:
+            self._step = int(step)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"rank": self.rank, "step": self._step,
+                       "time": time.time()}, f)
+        os.replace(tmp, self.path)
+
+    def start(self):
+        def beat():
+            while not self._stop.wait(self.interval_s):
+                self.update()
+        self.update()
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    @staticmethod
+    def check(directory, timeout_s=60.0):
+        """Supervisor side: ranks whose heartbeat is stale (dead/hung)."""
+        now = time.time()
+        stale = []
+        for name in sorted(os.listdir(directory)):
+            if not name.startswith("heartbeat_"):
+                continue
+            with open(os.path.join(directory, name)) as f:
+                rec = json.load(f)
+            if now - rec["time"] > timeout_s:
+                stale.append(rec["rank"])
+        return stale
+
+
+class StallMonitor:
+    """Fires `on_stall` when no step completes for `timeout_s` — a hung
+    collective or dead input pipeline looks exactly like this (the
+    reference's heartbeat CHECK loop, heart_beat_monitor.cc:?? applied to
+    single-controller training)."""
+
+    def __init__(self, timeout_s=300.0,
+                 on_stall: Optional[Callable[[float], None]] = None):
+        self.timeout_s = timeout_s
+        self.on_stall = on_stall or (lambda dt: print(
+            f"[paddle_tpu] WARNING: no training step for {dt:.0f}s — "
+            "hung collective or starved input pipeline?", flush=True))
+        self._last = time.time()
+        self._stop = threading.Event()
+        self._thread = None
+        self.stalled = False
+
+    def step_done(self):
+        self._last = time.time()
+        self.stalled = False
+
+    def start(self):
+        def watch():
+            while not self._stop.wait(min(self.timeout_s / 4, 30.0)):
+                dt = time.time() - self._last
+                if dt > self.timeout_s and not self.stalled:
+                    self.stalled = True
+                    self.on_stall(dt)
+        self._thread = threading.Thread(target=watch, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

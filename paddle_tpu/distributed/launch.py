@@ -31,32 +31,53 @@ def _build_env(rank, nranks, endpoints):
 
 
 def launch(script, script_args=(), nproc_per_node=1, host="127.0.0.1",
-           start_port=6170):
+           start_port=6170, elastic_retries=0):
+    """Start one process per rank and watch them (reference
+    utils.py:424 start_local_trainers + watch loop). With
+    elastic_retries > 0, a failed job RESTARTS as a whole up to that many
+    times — trainers resume from auto-checkpoint (incubate/checkpoint.py),
+    the reference's elastic knob made concrete (its snapshot stubs it,
+    distributed_strategy.py:1160; collective jobs can't hot-swap a rank
+    mid-step, so whole-job restart from the latest step is the recovery
+    unit)."""
     endpoints = [f"{host}:{start_port + i}" for i in range(nproc_per_node)]
-    procs = []
-    for rank in range(nproc_per_node):
-        cmd = [sys.executable, script, *script_args]
-        p = subprocess.Popen(cmd, env=_build_env(rank, nproc_per_node,
-                                                 endpoints))
-        procs.append(p)
-    # watch loop (reference utils.py watch of child trainers)
-    try:
-        while procs:
-            for p in list(procs):
-                ret = p.poll()
-                if ret is None:
-                    continue
-                procs.remove(p)
-                if ret != 0:
-                    for q in procs:
-                        q.send_signal(signal.SIGTERM)
-                    raise SystemExit(ret)
-            time.sleep(0.5)
-    except KeyboardInterrupt:
-        for p in procs:
-            p.send_signal(signal.SIGTERM)
-        raise
-    return 0
+
+    def start_all():
+        return [subprocess.Popen([sys.executable, script, *script_args],
+                                 env=_build_env(rank, nproc_per_node,
+                                                endpoints))
+                for rank in range(nproc_per_node)]
+
+    attempt = 0
+    while True:
+        procs = start_all()
+        failed_ret = None
+        try:
+            while procs:
+                for p in list(procs):
+                    ret = p.poll()
+                    if ret is None:
+                        continue
+                    procs.remove(p)
+                    if ret != 0:
+                        for q in procs:
+                            q.send_signal(signal.SIGTERM)
+                        for q in procs:
+                            q.wait()
+                        procs.clear()
+                        failed_ret = ret
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            raise
+        if failed_ret is None:
+            return 0
+        attempt += 1
+        if attempt > elastic_retries:
+            raise SystemExit(failed_ret)
+        print(f"[paddle_tpu.launch] job failed (rc={failed_ret}); elastic "
+              f"restart {attempt}/{elastic_retries}", flush=True)
 
 
 def main():
@@ -64,11 +85,13 @@ def main():
     ap = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     ap.add_argument("--nproc_per_node", type=int, default=1)
     ap.add_argument("--started_port", type=int, default=6170)
+    ap.add_argument("--elastic_retries", type=int, default=0)
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     return launch(args.script, args.script_args, args.nproc_per_node,
-                  start_port=args.started_port)
+                  start_port=args.started_port,
+                  elastic_retries=args.elastic_retries)
 
 
 if __name__ == "__main__":

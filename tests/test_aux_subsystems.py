@@ -122,3 +122,53 @@ def test_sigterm_grace_checkpoint(tmp_path):
     assert ck.latest_step() == 3
     st = ck.restore()
     assert st["counters"]["global_step"] == 3
+
+
+# ------------------------- elastic / heartbeats ----------------------------
+
+def test_heartbeat_update_and_check(tmp_path):
+    from paddle_tpu.distributed.elastic import Heartbeat
+    hb = Heartbeat(str(tmp_path), rank=0, interval_s=0.05).start()
+    hb.update(step=7)
+    import json
+    with open(hb.path) as f:
+        rec = json.load(f)
+    assert rec["rank"] == 0 and rec["step"] == 7
+    assert Heartbeat.check(str(tmp_path), timeout_s=60) == []
+    hb.stop()
+    import time
+    time.sleep(0.15)
+    assert Heartbeat.check(str(tmp_path), timeout_s=0.05) == [0]
+
+
+def test_stall_monitor_fires():
+    import time
+    from paddle_tpu.distributed.elastic import StallMonitor
+    fired = []
+    with StallMonitor(timeout_s=0.2, on_stall=fired.append) as m:
+        m.step_done()
+        time.sleep(0.5)
+    assert fired and fired[0] >= 0.2
+    assert m.stalled
+
+
+def test_launch_elastic_restart(tmp_path):
+    """A trainer that crashes on its first attempt and succeeds after a
+    restart (state via a marker file, standing in for auto-checkpoint
+    resume)."""
+    import textwrap
+    from paddle_tpu.distributed.launch import launch
+    script = os.path.join(str(tmp_path), "train.py")
+    marker = os.path.join(str(tmp_path), "attempted")
+    with open(script, "w") as f:
+        f.write(textwrap.dedent(f"""
+            import os, sys
+            if not os.path.exists({marker!r}):
+                open({marker!r}, "w").close()
+                sys.exit(1)       # first attempt: crash
+            sys.exit(0)           # resumed attempt: success
+        """))
+    assert launch(script, nproc_per_node=1, elastic_retries=2) == 0
+    with pytest.raises(SystemExit):
+        os.remove(marker)
+        launch(script, nproc_per_node=1, elastic_retries=0)
