@@ -51,7 +51,21 @@ def test_word2vec_book_sparse_grads():
         opt.clear_grad()
         losses.append(float(loss.numpy()))
     assert saw_sparse, "sparse embedding grads never materialized"
-    # repeat the corpus a few epochs to see a real drop
+
+    # like-for-like convergence: the pos-only objective before vs after
+    # several more epochs of training (same loss form on both sides)
+    def pos_loss():
+        from paddle_tpu.core import tape as _tape
+        vals = []
+        with _tape.no_grad():
+            for lo in range(0, N, 64):
+                c = paddle.to_tensor(centers[lo:lo + 64].astype("int64"))
+                t = paddle.to_tensor(contexts[lo:lo + 64].astype("int64"))
+                vals.append(float(ops.mean(ops.softplus(
+                    -ops.sum(emb_in(c) * emb_out(t), axis=-1))).numpy()))
+        return float(np.mean(vals))
+
+    before = pos_loss()
     for _ in range(4):
         for lo in range(0, N, 64):
             c = paddle.to_tensor(centers[lo:lo + 64].astype("int64"))
@@ -61,8 +75,8 @@ def test_word2vec_book_sparse_grads():
             loss.backward()
             opt.step()
             opt.clear_grad()
-            losses.append(float(loss.numpy()))
-    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    after = pos_loss()
+    assert after < before * 0.5, (before, after)
 
 
 def test_huge_vocab_sharded_embedding_mesh8():
@@ -75,10 +89,10 @@ def test_huge_vocab_sharded_embedding_mesh8():
     mesh = mesh_mod.init_mesh({"tp": 8})
     V, D, B = 1_048_576, 32, 16
     rng = np.random.RandomState(0)
-    # the full table never lives on one device: build it sharded
-    table = jax.device_put(
-        jnp.asarray(rng.randn(V, D).astype(np.float32) * 0.01),
-        NamedSharding(mesh, P("tp", None)))
+    # build host-side once (f32), shard to devices; the host copy doubles
+    # as the gather reference so the sharded table never pulls back whole
+    host = (rng.randn(V, D) * 0.01).astype(np.float32)
+    table = jax.device_put(host, NamedSharding(mesh, P("tp", None)))
     ids = jnp.asarray(rng.randint(0, V, (B,)), jnp.int32)
 
     per_shard = V // 8
@@ -96,6 +110,6 @@ def test_huge_vocab_sharded_embedding_mesh8():
     out = jax.jit(jax.shard_map(
         spmd, mesh=mesh, in_specs=(P("tp", None), P()),
         out_specs=P(), check_vma=False))(table, ids)
-    want = np.asarray(table)[np.asarray(ids)]
+    want = host[np.asarray(ids)]
     np.testing.assert_allclose(np.asarray(out), want, atol=1e-6)
     mesh_mod.init_mesh({"dp": 8})
