@@ -1,0 +1,83 @@
+"""Beam-search dynamic decoding (reference fluid/layers/rnn.py
+BeamSearchDecoder + dynamic_decode; SURVEY hard part 2)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops
+from paddle_tpu.nn import BeamSearchDecoder, dynamic_decode
+
+
+class _ToyCell(nn.Layer):
+    """Deterministic 'language model': state is the last token's one-hot;
+    logits force token (prev + 1) % V until V-1 (= end)."""
+
+    def __init__(self, V):
+        super().__init__()
+        self.V = V
+
+    def forward(self, inputs, states):
+        # inputs: [n] int64 token ids; states: [n, V] dummy hidden
+        onehot = ops.one_hot(inputs, self.V).astype("float32")
+        nxt = ops.one_hot((inputs + 1) % self.V, self.V).astype("float32")
+        logits = nxt * 10.0  # strongly prefer prev+1
+        return logits, states
+
+
+def test_greedy_path_via_beam1():
+    V = 6
+    cell = _ToyCell(V)
+    dec = BeamSearchDecoder(cell, start_token=0, end_token=V - 1,
+                            beam_size=1)
+    inits = paddle.to_tensor(np.zeros((2, V), "float32"))  # batch 2
+    (paths, scores), _ = dynamic_decode(dec, inits, max_step_num=10)
+    p = np.asarray(paths._value)
+    assert p.shape[:2] == (2, 1)
+    # from start 0: 1, 2, 3, 4, 5(end) — decode stops at end token
+    np.testing.assert_array_equal(p[0, 0], [1, 2, 3, 4, 5])
+    np.testing.assert_array_equal(p[1, 0], p[0, 0])
+
+
+def test_beam_search_orders_hypotheses():
+    """A cell with two strong continuations: the beam must keep both and
+    rank the higher-probability path first."""
+    V = 5
+
+    class TwoWay(nn.Layer):
+        def forward(self, inputs, states):
+            n = inputs.shape[0]
+            base = np.full((1, V), -10.0, np.float32)
+            logits = np.repeat(base, n, 0)
+            prev = np.asarray(inputs._value)
+            # from 0: token 1 (p~0.6) or 2 (p~0.4); everything then ends (4)
+            logits[prev == 0, 1] = np.log(0.6) + 10
+            logits[prev == 0, 2] = np.log(0.4) + 10
+            logits[prev == 1, 4] = 10.0
+            logits[prev == 2, 4] = 10.0
+            logits[prev == 4, 4] = 10.0
+            return paddle.to_tensor(logits), states
+
+    dec = BeamSearchDecoder(TwoWay(), start_token=0, end_token=4,
+                            beam_size=2)
+    inits = paddle.to_tensor(np.zeros((1, 3), "float32"))
+    (paths, scores), _ = dynamic_decode(dec, inits, max_step_num=6)
+    p = np.asarray(paths._value)[0]          # [beam, T]
+    s = np.asarray(scores._value)[0]
+    assert p[0, 0] == 1 and p[1, 0] == 2     # both continuations kept
+    assert s[0] > s[1]                       # ranked by joint score
+    assert (p[:, 1] == 4).all()              # both reached end
+
+
+def test_beam_with_lstm_cell_runs():
+    paddle.seed(0)
+    V, H = 12, 8
+    cell = nn.LSTMCell(H, H)
+    emb = nn.Embedding(V, H)
+    proj = nn.Linear(H, V)
+    dec = BeamSearchDecoder(cell, start_token=1, end_token=2, beam_size=3,
+                            embedding_fn=emb, output_fn=proj)
+    b = 2
+    inits = (paddle.to_tensor(np.zeros((b, H), "float32")),
+             paddle.to_tensor(np.zeros((b, H), "float32")))
+    (paths, scores), _ = dynamic_decode(dec, inits, max_step_num=5)
+    assert np.asarray(paths._value).shape[:2] == (b, 3)
+    assert np.isfinite(np.asarray(scores._value)).all()
