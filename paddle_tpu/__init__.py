@@ -70,7 +70,7 @@ _SUBMODULES = ("nn", "optimizer", "metric", "io", "amp", "static",
                "distributed", "vision", "jit", "hapi", "incubate",
                "profiler", "text", "sysconfig", "callbacks", "inference",
                "framework", "regularizer", "memory", "quantization",
-               "distribution")
+               "distribution", "version")
 
 
 def __getattr__(name):

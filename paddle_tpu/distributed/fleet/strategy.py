@@ -5,6 +5,19 @@ Analog of reference framework/distributed_strategy.proto (:115, sub-messages
 instead of selecting program-rewriting meta-optimizers, the knobs configure
 the compiled step: mesh degrees, sharding rules, amp/recompute/gradient-
 merge wrappers.
+
+Knobs that are deliberately inert here, with the reasoning:
+- `dgc` (deep gradient compression) and `localsgd`/`adaptive_localsgd`:
+  both exist to cheapen the gradient exchange between DIVERGENT replicas
+  over slow interconnects. Under the single-controller SPMD model there
+  are no divergent replicas — parameters are one sharded/replicated
+  array, and XLA emits the exact gradient reduction over ICI, whose
+  bandwidth is what these tricks trade accuracy to save. SURVEY §2.2
+  rates both optional for this reason; accepting the flags keeps
+  reference configs loadable.
+- `fuse_all_reduce_ops`, `nccl_comm_num`, `fuse_grad_size_in_MB`: XLA
+  owns collective fusion and scheduling.
+- `a_sync` (async PS training): deferred with the PS stack (N20-N22).
 """
 from __future__ import annotations
 

@@ -1,0 +1,30 @@
+"""Version metadata (reference python/paddle/version.py, generated at
+build time there; static here)."""
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "unknown"
+with_mkl = "OFF"
+
+
+def show():
+    print(f"paddle_tpu {full_version}")
+    print("compute backend: XLA/PJRT (TPU-first; CPU for tests)")
+
+
+def cuda():
+    """Reference parity: the CUDA toolkit version. TPU-native build — no
+    CUDA in the loop."""
+    return False
+
+
+def cudnn():
+    return False
+
+
+def xpu():
+    return False
