@@ -272,3 +272,101 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return jnp.mean(
             loss / jnp.maximum(label_lengths.astype(jnp.float32), 1.0))
     return _reduce(loss, reduction)
+
+
+@defop
+def linear_chain_crf(emission, transition, label, length=None):
+    """Linear-chain CRF negative log-likelihood (reference
+    operators/linear_chain_crf_op.cc — alpha recursion over the log
+    partition; transition[0]=start scores, transition[1]=stop scores,
+    transition[2:]=pairwise [num_tags, num_tags], matching the reference's
+    parameter layout).
+
+    emission: [B, T, N]; transition: [N+2, N]; label: [B, T] int;
+    length: [B] or None (= full T). Returns per-sequence NLL [B].
+    """
+    em = emission.astype(jnp.float32)
+    B, T, N = em.shape
+    start = transition[0].astype(jnp.float32)            # [N]
+    stop = transition[1].astype(jnp.float32)             # [N]
+    trans = transition[2:].astype(jnp.float32)           # [N, N] from->to
+    label = label.astype(jnp.int32)
+    if length is None:
+        length = jnp.full((B,), T, jnp.int32)
+    length = length.astype(jnp.int32)
+
+    # ---- log partition via alpha recursion -------------------------------
+    alpha0 = start[None, :] + em[:, 0]                   # [B, N]
+
+    def step(alpha, inp):
+        e_t, t = inp                                     # [B, N], scalar
+        scores = alpha[:, :, None] + trans[None]         # [B, from, to]
+        new = jax.scipy.special.logsumexp(scores, axis=1) + e_t
+        active = (t < length)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(
+        step, alpha0,
+        (jnp.moveaxis(em, 1, 0)[1:], jnp.arange(1, T, dtype=jnp.int32)))
+    logZ = jax.scipy.special.logsumexp(alpha + stop[None, :], axis=1)
+
+    # ---- gold path score -------------------------------------------------
+    brange = jnp.arange(B)
+    gold = start[label[:, 0]] + em[brange, 0, label[:, 0]]
+
+    def gold_step(acc, inp):
+        prev_y, y, e_t, t = inp
+        add = trans[prev_y, y] + e_t[brange, y]
+        return jnp.where(t < length, acc + add, acc), None
+
+    gold, _ = jax.lax.scan(
+        gold_step, gold,
+        (jnp.moveaxis(label, 1, 0)[:-1], jnp.moveaxis(label, 1, 0)[1:],
+         jnp.moveaxis(em, 1, 0)[1:], jnp.arange(1, T, dtype=jnp.int32)))
+    last = jnp.clip(length - 1, 0, T - 1)
+    gold = gold + stop[label[brange, last]]
+    return logZ - gold
+
+
+@defop
+def viterbi_decode(emission, transition, length=None):
+    """CRF argmax decoding (reference operators/crf_decoding_op.cc /
+    paddle.text.viterbi_decode): returns (scores [B], paths [B, T])."""
+    em = emission.astype(jnp.float32)
+    B, T, N = em.shape
+    start = transition[0].astype(jnp.float32)
+    stop = transition[1].astype(jnp.float32)
+    trans = transition[2:].astype(jnp.float32)
+    if length is None:
+        length = jnp.full((B,), T, jnp.int32)
+    length = length.astype(jnp.int32)
+
+    v0 = start[None, :] + em[:, 0]
+
+    def step(v, inp):
+        e_t, t = inp
+        scores = v[:, :, None] + trans[None]             # [B, from, to]
+        best_prev = jnp.argmax(scores, axis=1)           # [B, to]
+        new = jnp.max(scores, axis=1) + e_t
+        active = (t < length)[:, None]
+        v_next = jnp.where(active, new, v)
+        bp = jnp.where(active, best_prev,
+                       jnp.arange(N)[None, :].repeat(B, 0))
+        return v_next, bp
+
+    v, bps = jax.lax.scan(
+        step, v0,
+        (jnp.moveaxis(em, 1, 0)[1:], jnp.arange(1, T, dtype=jnp.int32)))
+    final = v + stop[None, :]
+    scores = jnp.max(final, axis=1)
+    last_tag = jnp.argmax(final, axis=1)                 # [B]
+
+    def backtrack(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    _, path_rev = jax.lax.scan(backtrack, last_tag, bps, reverse=True)
+    paths = jnp.concatenate(
+        [jnp.moveaxis(path_rev, 0, 1),
+         last_tag[:, None]], axis=1)                     # [B, T]
+    return scores, paths.astype(jnp.int64)

@@ -1,0 +1,11 @@
+"""paddle.vision.ops — detection operator namespace (the 2.x home of
+roi_align/nms/yolo_box; reference python/paddle/vision/ops.py re-exports
+over operators/detection/). Implementations live in
+paddle_tpu/ops/detection.py."""
+from ..ops.detection import (  # noqa: F401
+    bipartite_match, box_clip, box_coder, iou_similarity, multiclass_nms,
+    nms, prior_box, roi_align, roi_pool, yolo_box)
+
+__all__ = ["roi_align", "roi_pool", "nms", "multiclass_nms", "yolo_box",
+           "prior_box", "box_coder", "box_clip", "iou_similarity",
+           "bipartite_match"]

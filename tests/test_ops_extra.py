@@ -249,3 +249,49 @@ def test_ctc_loss_matches_torch():
                         torch.tensor(labels), torch.tensor(in_lens),
                         torch.tensor(lab_lens), blank=0, reduction="sum")
     np.testing.assert_allclose(float(l2.numpy()), float(t_sum), rtol=1e-4)
+
+
+def test_linear_chain_crf_vs_bruteforce():
+    """CRF NLL and viterbi vs exhaustive path enumeration (reference
+    linear_chain_crf_op.cc, crf_decoding_op.cc), incl. ragged lengths."""
+    import itertools
+    rng = np.random.RandomState(0)
+    B, Tm, N = 2, 3, 3
+    em = rng.randn(B, Tm, N).astype("float32")
+    trans = rng.randn(N + 2, N).astype("float32")
+    start, stop, pair = trans[0], trans[1], trans[2:]
+    labels = rng.randint(0, N, (B, Tm)).astype("int64")
+    lengths = np.array([3, 2], "int64")
+
+    def path_score(b, path):
+        s = start[path[0]] + em[b, 0, path[0]]
+        for t in range(1, len(path)):
+            s += pair[path[t - 1], path[t]] + em[b, t, path[t]]
+        return s + stop[path[-1]]
+
+    want_nll, want_path = [], []
+    for b in range(B):
+        L = int(lengths[b])
+        scores = {p: path_score(b, p)
+                  for p in itertools.product(range(N), repeat=L)}
+        logZ = np.logaddexp.reduce(np.array(list(scores.values())))
+        gold = path_score(b, tuple(labels[b, :L]))
+        want_nll.append(logZ - gold)
+        want_path.append(max(scores, key=scores.get))
+
+    nll = ops.linear_chain_crf(T(em), T(trans), T(labels),
+                               T(lengths)).numpy()
+    np.testing.assert_allclose(nll, want_nll, rtol=1e-5)
+
+    scores, paths = ops.viterbi_decode(T(em), T(trans), T(lengths))
+    p = paths.numpy()
+    for b in range(B):
+        L = int(lengths[b])
+        np.testing.assert_array_equal(p[b, :L], want_path[b])
+
+    # differentiable: grads flow to emissions and transitions
+    e_t, tr_t = T(em), T(trans)
+    e_t.stop_gradient = tr_t.stop_gradient = False
+    ops.linear_chain_crf(e_t, tr_t, T(labels), T(lengths)).sum().backward()
+    assert np.isfinite(np.asarray(e_t.grad._value)).all()
+    assert np.isfinite(np.asarray(tr_t.grad._value)).all()
