@@ -27,7 +27,8 @@ __all__ = [
     "diagonal_scatter", "select_scatter", "slice_scatter", "unflatten",
     "view_as", "cdist", "pdist", "corrcoef", "cov", "cholesky_solve",
     "lu", "lu_unpack", "fold", "histogramdd", "standard_gamma", "binomial",
-    "log_normal",
+    "log_normal", "channel_shuffle", "pixel_unshuffle", "affine_grid",
+    "grid_sample",
 ]
 
 
@@ -386,3 +387,94 @@ def log_normal(mean=1.0, std=2.0, shape=None):
     shape = shape or ()
     return jnp.exp(mean + std * jax.random.normal(_rng.next_key(),
                                                   tuple(shape)))
+
+
+@defop
+def channel_shuffle(x, groups, data_format="NCHW"):
+    """reference channel_shuffle_op.cc."""
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        return x.reshape(n, groups, c // groups, h, w) \
+                .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    return x.reshape(n, h, w, groups, c // groups) \
+            .transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+
+@defop
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    """reference pixel_unshuffle_op.cc (inverse of pixel_shuffle)."""
+    r = downscale_factor
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r,
+                                                 w // r)
+
+
+@defop
+def affine_grid(theta, out_shape, align_corners=True):
+    """reference affine_grid_op.cc: 2D affine sampling grid from theta
+    [N, 2, 3] for an output [N, C, H, W] -> grid [N, H, W, 2] (x, y) in
+    [-1, 1] normalized coordinates."""
+    n, _, H, W = [int(s) for s in out_shape]
+
+    def lin(m):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, m)
+        return (jnp.arange(m, dtype=jnp.float32) * 2 + 1) / m - 1.0
+
+    ys, xs = lin(H), lin(W)
+    xg, yg = jnp.meshgrid(xs, ys)                        # [H, W]
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, ones], axis=-1)            # [H, W, 3]
+    return jnp.einsum("hwk,njk->nhwj", base, theta.astype(jnp.float32))
+
+
+@defop
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """reference grid_sampler_op.cc: sample x [N,C,H,W] at grid
+    [N,Ho,Wo,2] (x,y in [-1,1])."""
+    n, c, H, W = x.shape
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1) * (size - 1) / 2
+        return ((g + 1) * size - 1) / 2
+
+    gx = unnorm(grid[..., 0].astype(jnp.float32), W)     # [N, Ho, Wo]
+    gy = unnorm(grid[..., 1].astype(jnp.float32), H)
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, W - 1)
+        gy = jnp.clip(gy, 0, H - 1)
+    if mode == "nearest":
+        ix = jnp.clip(jnp.round(gx), 0, W - 1).astype(jnp.int32)
+        iy = jnp.clip(jnp.round(gy), 0, H - 1).astype(jnp.int32)
+        valid = ((gx >= -0.5) & (gx <= W - 0.5)
+                 & (gy >= -0.5) & (gy <= H - 0.5)) \
+            if padding_mode == "zeros" else jnp.ones_like(gx, bool)
+        out = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, iy, ix)
+        return out * valid[:, None].astype(x.dtype)
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def tap(ix, iy):
+        inb = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+        cx = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+        cy = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+        v = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, cy, cx)
+        if padding_mode == "zeros":
+            v = v * inb[:, None].astype(x.dtype)
+        return v
+
+    v00 = tap(x0, y0)
+    v01 = tap(x0 + 1, y0)
+    v10 = tap(x0, y0 + 1)
+    v11 = tap(x0 + 1, y0 + 1)
+    wxe = wx[:, None].astype(x.dtype)
+    wye = wy[:, None].astype(x.dtype)
+    return (v00 * (1 - wxe) * (1 - wye) + v01 * wxe * (1 - wye)
+            + v10 * (1 - wxe) * wye + v11 * wxe * wye)

@@ -295,3 +295,48 @@ def test_linear_chain_crf_vs_bruteforce():
     ops.linear_chain_crf(e_t, tr_t, T(labels), T(lengths)).sum().backward()
     assert np.isfinite(np.asarray(e_t.grad._value)).all()
     assert np.isfinite(np.asarray(tr_t.grad._value)).all()
+
+
+def test_grid_sample_and_affine_grid_vs_torch():
+    """Golden vs torch grid_sample/affine_grid (CPU torch implements the
+    same grid_sampler_op semantics)."""
+    import torch
+    import torch.nn.functional as tF
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 7).astype("float32")
+    theta = rng.randn(2, 2, 3).astype("float32") * 0.3
+
+    for align in (True, False):
+        grid_t = tF.affine_grid(torch.tensor(theta), (2, 3, 4, 6),
+                                align_corners=align).numpy()
+        grid_m = ops.affine_grid(T(theta), (2, 3, 4, 6),
+                                 align_corners=align).numpy()
+        np.testing.assert_allclose(grid_m, grid_t, atol=1e-5)
+
+        for mode in ("bilinear", "nearest"):
+            for pad in ("zeros", "border"):
+                want = tF.grid_sample(torch.tensor(x),
+                                      torch.tensor(grid_t), mode=mode,
+                                      padding_mode=pad,
+                                      align_corners=align).numpy()
+                got = ops.grid_sample(T(x), T(grid_t), mode=mode,
+                                      padding_mode=pad,
+                                      align_corners=align).numpy()
+                np.testing.assert_allclose(got, want, atol=1e-4,
+                                           err_msg=f"{mode}/{pad}/{align}")
+
+
+def test_channel_shuffle_and_pixel_unshuffle():
+    import torch
+    import torch.nn.functional as tF
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 8, 4, 4).astype("float32")
+    got = ops.channel_shuffle(T(x), 2).numpy()
+    want = tF.channel_shuffle(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(got, want)
+    got = ops.pixel_unshuffle(T(x), 2).numpy()
+    want = tF.pixel_unshuffle(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(got, want)
+    # round trip with the existing pixel_shuffle
+    back = ops.pixel_shuffle(T(got), 2).numpy()
+    np.testing.assert_allclose(back, x)
