@@ -70,7 +70,7 @@ _SUBMODULES = ("nn", "optimizer", "metric", "io", "amp", "static",
                "distributed", "vision", "jit", "hapi", "incubate",
                "profiler", "text", "sysconfig", "callbacks", "inference",
                "framework", "regularizer", "memory", "quantization",
-               "distribution", "version")
+               "distribution", "version", "utils")
 
 
 def __getattr__(name):

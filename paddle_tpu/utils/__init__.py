@@ -1,2 +1,51 @@
 from . import unique_name  # noqa: F401
 from .log_writer import LogWriter, read_scalars  # noqa: F401
+
+
+def run_check():
+    """Install sanity check (reference paddle.utils.run_check /
+    fluid/install_check.py: trains a tiny model, reports the device
+    story). Runs one regression step on the default backend and a
+    dp-sharded step over all local devices."""
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    devs = jax.devices()
+    print(f"paddle_tpu is installed; backend={devs[0].platform} "
+          f"device_count={len(devs)}")
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).rand(8, 4)
+                         .astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).rand(8, 1)
+                         .astype("float32"))
+    before = float(((lin(x) - y) ** 2).mean().numpy())
+    for _ in range(5):
+        loss = ((lin(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    after = float(((lin(x) - y) ** 2).mean().numpy())
+    assert after < before, (before, after)
+    print("single-device train step: OK")
+
+    if len(devs) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed import mesh as mesh_mod
+        mesh = mesh_mod.init_mesh({"dp": len(devs)})
+        import jax.numpy as jnp
+        w = jax.device_put(jnp.zeros((4,)), NamedSharding(mesh, P()))
+        xb = jax.device_put(jnp.ones((len(devs) * 2, 4)),
+                            NamedSharding(mesh, P("dp")))
+        step = jax.jit(lambda w, x: w + x.mean(0),
+                       in_shardings=(NamedSharding(mesh, P()),
+                                     NamedSharding(mesh, P("dp"))),
+                       out_shardings=NamedSharding(mesh, P()))
+        np.testing.assert_allclose(np.asarray(step(w, xb)), np.ones(4))
+        print(f"{len(devs)}-device dp-sharded step: OK")
+    print("paddle_tpu run_check passed.")
