@@ -75,3 +75,43 @@ class GPT(nn.Layer):
                 x, self.wte.weight, None, labels, ignore_index=-100)
         # weight-tied LM head
         return ops.matmul(x, self.wte.weight, transpose_y=True)
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=None, eos_token_id=None):
+        """Autoregressive sampling (reference generation utils; greedy at
+        temperature=0). Eager host loop re-forwarding the growing prefix —
+        the simple inference form; the flash kernel keeps each forward
+        O(s) in memory. Returns [b, s + new] ids."""
+        import numpy as np
+
+        from ...core import tape as _tape
+
+        with _tape.no_grad():
+            ids = input_ids
+            finished = np.zeros(int(ids.shape[0]), bool)
+            for _ in range(max_new_tokens):
+                logits = self(ids)[:, -1]                 # [b, V]
+                if temperature == 0:
+                    nxt = ops.argmax(logits, axis=-1)
+                else:
+                    logits = logits / float(temperature)
+                    if top_k is not None:
+                        kth = ops.topk(logits, top_k, axis=-1)[0][:, -1:]
+                        logits = ops.where(
+                            logits < kth,
+                            ops.full_like(logits, -1e9), logits)
+                    from ...distribution import Categorical
+                    nxt = Categorical(logits=logits._value).sample()
+                nxt = ops.reshape(nxt, [-1, 1]).astype("int64")
+                if eos_token_id is not None:
+                    keep = np.asarray(~finished)[:, None]
+                    from ... import to_tensor
+                    nxt = ops.where(
+                        to_tensor(keep), nxt,
+                        ops.full_like(nxt, eos_token_id))
+                    finished |= (
+                        np.asarray(nxt._value)[:, 0] == eos_token_id)
+                ids = ops.concat([ids, nxt], axis=1)
+                if eos_token_id is not None and finished.all():
+                    break
+            return ids

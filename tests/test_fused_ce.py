@@ -127,3 +127,30 @@ def test_gpt_causal_flag_and_fused_loss(interpret):
                                float(loss_ref._value), rtol=1e-5)
     loss_fused.backward()
     assert net.wte.weight.grad is not None
+
+
+def test_gpt_generate(interpret):
+    from paddle_tpu.text.models.gpt import GPT, GPTConfig
+    cfg = GPTConfig.tiny()
+    paddle.seed(7)
+    net = GPT(cfg)
+    net.eval()
+    prompt = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 4)).astype(
+            "int64"))
+    # greedy: deterministic
+    out1 = net.generate(prompt, max_new_tokens=6, temperature=0)
+    out2 = net.generate(prompt, max_new_tokens=6, temperature=0)
+    a, b = np.asarray(out1._value), np.asarray(out2._value)
+    assert a.shape == (2, 10)
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(a[:, :4], np.asarray(prompt._value))
+    # sampling with top_k produces valid ids
+    out3 = net.generate(prompt, max_new_tokens=3, temperature=1.0, top_k=5)
+    v = np.asarray(out3._value)
+    assert v.shape == (2, 7) and (v >= 0).all() and (v < cfg.vocab_size).all()
+    # eos early stop
+    eos = int(a[0, 4])  # force an eos that will occur greedily
+    out4 = net.generate(prompt, max_new_tokens=6, temperature=0,
+                        eos_token_id=eos)
+    assert np.asarray(out4._value).shape[1] <= 10
