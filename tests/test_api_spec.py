@@ -1,0 +1,24 @@
+"""API-surface guard (reference tools/ API.spec approval discipline):
+the live public surface must match the committed snapshot, so removals
+and signature changes are deliberate. Regenerate with
+`python tools/gen_api_spec.py --update`."""
+import os
+import sys
+
+
+def test_api_surface_matches_spec():
+    sys.path.insert(0, "/root/repo/tools")
+    import gen_api_spec
+    live = gen_api_spec.collect()
+    with open("/root/repo/API.spec") as f:
+        committed = f.read()
+    if live != committed:
+        live_set = set(live.splitlines())
+        comm_set = set(committed.splitlines())
+        removed = sorted(comm_set - live_set)[:20]
+        added = sorted(live_set - comm_set)[:20]
+        raise AssertionError(
+            "public API surface drifted from API.spec — if intentional, "
+            "run `python tools/gen_api_spec.py --update`.\n"
+            f"removed/changed: {removed}\nadded/changed: {added}")
+    assert "MISSING" not in committed

@@ -1,0 +1,86 @@
+"""API-surface snapshot (reference tools/ check scripts +
+paddle/fluid/API.spec: every public API recorded with its signature, so
+surface changes are deliberate and reviewed).
+
+Usage:
+  python tools/gen_api_spec.py            # print current spec
+  python tools/gen_api_spec.py --update   # rewrite API.spec
+The test suite diffs the live surface against the committed API.spec.
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))  # runnable as `python tools/gen_api_spec.py`
+
+NAMESPACES = [
+    "paddle_tpu", "paddle_tpu.nn", "paddle_tpu.nn.functional",
+    "paddle_tpu.optimizer", "paddle_tpu.optimizer.lr", "paddle_tpu.static",
+    "paddle_tpu.static.nn", "paddle_tpu.distributed",
+    "paddle_tpu.distributed.fleet", "paddle_tpu.amp", "paddle_tpu.metric",
+    "paddle_tpu.io", "paddle_tpu.jit", "paddle_tpu.inference",
+    "paddle_tpu.profiler", "paddle_tpu.memory", "paddle_tpu.quantization",
+    "paddle_tpu.distribution", "paddle_tpu.incubate.checkpoint",
+    "paddle_tpu.vision.ops", "paddle_tpu.utils", "paddle_tpu.callbacks",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def _resolve(ns):
+    """Import the namespace; object-valued namespaces (static.nn is an
+    instance) resolve by getattr from the parent module."""
+    import importlib
+    try:
+        return importlib.import_module(ns)
+    except ModuleNotFoundError:
+        parent, _, leaf = ns.rpartition(".")
+        return getattr(importlib.import_module(parent), leaf)
+
+
+def collect():
+    lines = []
+    for ns in NAMESPACES:
+        mod = _resolve(ns)
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(set(names)):
+            try:
+                obj = getattr(mod, name)
+            except AttributeError:
+                lines.append(f"{ns}.{name} MISSING")
+                continue
+            if inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                lines.append(f"{ns}.{name} class{_sig(obj)}")
+            elif callable(obj):
+                lines.append(f"{ns}.{name} def{_sig(obj)}")
+            else:
+                lines.append(f"{ns}.{name} value:{type(obj).__name__}")
+    return "\n".join(lines) + "\n"
+
+
+def main():
+    spec = collect()
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "API.spec")
+    if "--update" in sys.argv:
+        with open(path, "w") as f:
+            f.write(spec)
+        print(f"wrote {path} ({spec.count(chr(10))} entries)")
+    else:
+        sys.stdout.write(spec)
+
+
+if __name__ == "__main__":
+    main()
