@@ -73,6 +73,11 @@ _SUBMODULES = ("nn", "optimizer", "metric", "io", "amp", "static",
                "distribution", "version", "utils")
 
 
+# Lazily-injected non-module names (see __getattr__); enumerated so the
+# API.spec snapshot is deterministic regardless of import order.
+__all_lazy__ = ("Model", "summary", "flops", "save", "load")
+
+
 def __getattr__(name):
     if name in _SUBMODULES:
         mod = _importlib.import_module(f".{name}", __name__)

@@ -12,7 +12,7 @@ import importlib as _importlib
 
 _LAZY_MODULES = ("fleet", "sharding", "pipeline", "launch", "spawn", "moe",
                  "collective", "parallel", "ring_attention", "bootstrap",
-                 "elastic")
+                 "elastic", "ps")
 _LAZY_NAMES = {
     "recompute": "recompute", "checkpoint_policy": "recompute",
     "all_gather": "collective", "all_reduce": "collective",
@@ -23,6 +23,12 @@ _LAZY_NAMES = {
     "DataParallel": "parallel", "init_parallel_env": "parallel",
     "ring_attention_fn": "ring_attention",
 }
+
+
+# Lazily-injected non-module names; enumerated so the API.spec snapshot is
+# deterministic regardless of import order (see tools/gen_api_spec.py).
+__all_lazy__ = tuple(_LAZY_NAMES) + (
+    "InMemoryDataset", "QueueDataset", "DatasetFactory")
 
 
 def __getattr__(name):
@@ -38,8 +44,14 @@ def __getattr__(name):
         return mod
     if name in _LAZY_NAMES:
         mod = _importlib.import_module(f".{_LAZY_NAMES[name]}", __name__)
-        val = getattr(mod, name if name != "ring_attention_fn" else "ring_attention")
-        globals()[name] = val
-        return val
+        # Importing a submodule binds it as a package attribute; when a
+        # public function shares its module's name (recompute), that binding
+        # would shadow the function for every later lookup. Materialize all
+        # names backed by this module now, overwriting any module binding.
+        for n, m in _LAZY_NAMES.items():
+            if m == _LAZY_NAMES[name]:
+                globals()[n] = getattr(
+                    mod, n if n != "ring_attention_fn" else "ring_attention")
+        return globals()[name]
     raise AttributeError(
         f"module 'paddle_tpu.distributed' has no attribute {name!r}")

@@ -27,7 +27,9 @@ __all__ = ["init", "DistributedStrategy", "PaddleCloudRoleMaker",
            "UserDefinedRoleMaker", "distributed_optimizer", "worker_index",
            "worker_num", "is_first_worker", "is_worker", "is_server",
            "worker_endpoints", "barrier_worker", "init_worker",
-           "stop_worker", "DistributedOptimizer", "get_hybrid_communicate_group"]
+           "stop_worker", "init_server", "run_server", "ps_client",
+           "ps_communicator", "DistributedOptimizer",
+           "get_hybrid_communicate_group"]
 
 _fleet_state = {
     "initialized": False,
@@ -40,10 +42,21 @@ _fleet_state = {
 
 def init(role_maker=None, is_collective=True, strategy=None):
     """reference fleet_base.py:130. Declares the mesh from the strategy's
-    hybrid degrees (replacing Gloo rendezvous + NCCL ring init)."""
+    hybrid degrees (replacing Gloo rendezvous + NCCL ring init).
+
+    With is_collective=False the job is parameter-server mode (reference
+    fleet/runtime/the_one_ps.py): no mesh, no jax bootstrap — server
+    processes are host-only; workers talk to servers through
+    paddle.distributed.ps (PADDLE_PSERVERS_IP_PORT_LIST env contract,
+    reference distributed/utils.py:406-409)."""
     strategy = strategy or DistributedStrategy()
     _fleet_state.update(initialized=True, role_maker=role_maker,
                         strategy=strategy, is_collective=is_collective)
+    if not is_collective:
+        if role_maker is None:
+            _fleet_state["role_maker"] = PaddleCloudRoleMaker(
+                is_collective=False)
+        return _FleetFacade()
     from ..bootstrap import maybe_initialize_distributed
     maybe_initialize_distributed()
     import jax
@@ -119,23 +132,28 @@ def get_hybrid_communicate_group():
 
 
 def worker_index():
-    return get_rank()
+    rm = _fleet_state.get("role_maker")
+    return rm.worker_index() if rm is not None else get_rank()
 
 
 def worker_num():
-    return get_world_size()
+    rm = _fleet_state.get("role_maker")
+    return rm.worker_num() if rm is not None else get_world_size()
 
 
 def is_first_worker():
-    return get_rank() == 0
+    rm = _fleet_state.get("role_maker")
+    return rm.is_first_worker() if rm is not None else get_rank() == 0
 
 
 def is_worker():
-    return True
+    rm = _fleet_state.get("role_maker")
+    return rm.is_worker() if rm is not None else True
 
 
 def is_server():
-    return False
+    rm = _fleet_state.get("role_maker")
+    return rm.is_server() if rm is not None else False
 
 
 def worker_endpoints(to_string=False):
@@ -149,20 +167,95 @@ def barrier_worker():
 
 
 def init_worker():
-    pass
+    """PS mode: connect a PSClient to all servers; strategy.a_sync adds
+    the background Communicator (reference fleet_base.py init_worker ->
+    the_one_ps._init_worker + communicator start)."""
+    if _fleet_state["is_collective"]:
+        return
+    from ..ps import Communicator, PSClient
+    rm = _fleet_state.get("role_maker")
+    eps = rm.get_pserver_endpoints() if rm is not None else []
+    if not eps:
+        eps = [e for e in os.environ.get(
+            "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
+    if not eps:
+        raise RuntimeError(
+            "PS mode needs server endpoints: pass them to the role maker "
+            "(UserDefinedRoleMaker(server_endpoints=[...])) or set "
+            "PADDLE_PSERVERS_IP_PORT_LIST (comma-separated host:port list)")
+    client = PSClient(eps)
+    _fleet_state["ps_client"] = client
+    strategy = _fleet_state["strategy"]
+    if strategy is not None and strategy.a_sync:
+        cfg = strategy.a_sync_configs or {}
+        _fleet_state["ps_communicator"] = Communicator(
+            client, send_every=cfg.get("send_queue_size", 4))
+
+
+def ps_client():
+    c = _fleet_state.get("ps_client")
+    if c is None:
+        raise RuntimeError("call fleet.init_worker() first")
+    return c
+
+
+def ps_communicator():
+    return _fleet_state.get("ps_communicator")
 
 
 def stop_worker():
-    pass
+    """Drain the communicator, rendezvous ALL workers at the server-side
+    stop barrier (so no server dies under a still-training peer), then
+    the first worker shuts the servers down (reference: trainers
+    deregister before pserver exit, heart_beat_monitor.cc)."""
+    if _fleet_state["is_collective"]:
+        return
+    comm = _fleet_state.pop("ps_communicator", None)
+    if comm is not None:
+        comm.flush()
+        comm.stop()
+    client = _fleet_state.pop("ps_client", None)
+    if client is not None:
+        try:
+            client.barrier(_STOP_BARRIER, worker_index())
+        except RuntimeError:
+            pass  # pre-ps-stack server config without the barrier table
+        if is_first_worker():
+            client.stop_servers()
+        client.close()
 
 
-def init_server(*args, **kwargs):
-    raise NotImplementedError(
-        "parameter-server mode: the TPU-native embedding/PS stack is the "
-        "planned sharded-embedding subsystem (SURVEY.md §7 hard-parts #5)")
+_STOP_BARRIER = "_fleet_stop_barrier"
 
 
-run_server = init_server
+def init_server(tables=None, endpoint=None):
+    """Build this process's PSServer from table specs (reference
+    fleet.init_server building tables out of ps.proto TableParameters;
+    here specs are explicit dicts — see distributed.ps.make_table). A
+    stop barrier sized to the trainer count is provisioned automatically
+    so stop_worker can rendezvous before servers exit."""
+    from ..ps import PSServer
+    if endpoint is None:
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "").split(",")
+        idx = int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+        endpoint = eps[idx] if eps and eps[0] else "127.0.0.1:0"
+    tables = dict(tables or {})
+    tables.setdefault(_STOP_BARRIER, {
+        "type": "barrier",
+        "trainer_num": int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))})
+    server = PSServer(endpoint, tables)
+    _fleet_state["ps_server"] = server
+    server.start()
+    return server
+
+
+def run_server():
+    """Blocks serving pull/push until a worker sends stop (reference
+    pscore/listen_and_serv_op.cc server loop)."""
+    server = _fleet_state.get("ps_server")
+    if server is None:
+        raise RuntimeError("call fleet.init_server() first")
+    server.run()
 
 
 class DistributedOptimizer:

@@ -36,7 +36,12 @@ class RoleMakerBase:
         return get_world_size()
 
     def server_num(self):
-        return 0
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        return len(eps.split(",")) if eps else 0
+
+    def get_pserver_endpoints(self):
+        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        return eps.split(",") if eps else []
 
     def get_trainer_endpoints(self):
         from ..env import ParallelEnv
@@ -58,9 +63,16 @@ class UserDefinedRoleMaker(RoleMakerBase):
         self._current_id = current_id
         self._role = role
         self._worker_num = worker_num
+        self._server_endpoints = list(server_endpoints or [])
 
     def worker_index(self):
         return self._current_id
 
     def worker_num(self):
         return self._worker_num
+
+    def server_num(self):
+        return len(self._server_endpoints) or super().server_num()
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints or super().get_pserver_endpoints()

@@ -17,7 +17,10 @@ Knobs that are deliberately inert here, with the reasoning:
   reference configs loadable.
 - `fuse_all_reduce_ops`, `nccl_comm_num`, `fuse_grad_size_in_MB`: XLA
   owns collective fusion and scheduling.
-- `a_sync` (async PS training): deferred with the PS stack (N20-N22).
+
+`a_sync` is live: with fleet.init(is_collective=False) it selects the
+async Communicator in the PS stack (distributed/ps; reference
+communicator.cc AsyncCommunicator).
 """
 from __future__ import annotations
 

@@ -1,0 +1,91 @@
+"""paddle.distributed.ps — parameter-server training.
+
+TPU-native re-design of the reference PS stack (SURVEY.md §2.1 N20-N22,
+hard part 5): N20 operators/distributed/ (RPC ops, Communicator,
+parameter_send row splitting, large_scale_kv), N21
+paddle/fluid/distributed/ (PSClient/PSServer + table layer), N22
+framework/fleet/fleet_wrapper.h (sync/async sparse/dense pull-push).
+
+The design split:
+- servers (table.py / server.py) are host-only numpy KV processes — no
+  JAX, no TPU; update rules run server-side on push (accessors).
+- workers keep ALL dense math on the TPU as usual; only the unbounded
+  sparse vocab goes through the PS. `SparseEmbedding` is the seam: pull
+  the rows a batch touches into a dense [n, dim] block (MXU-friendly),
+  run the jitted step, push back just those rows' grads — optionally
+  through the async `Communicator`.
+- `fleet.init(role_maker, is_collective=False)` + `strategy.a_sync`
+  selects this mode (reference fleet/runtime/the_one_ps.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .client import Communicator, PSClient
+from .server import PSServer
+from .table import (BarrierTable, DenseTable, GeoSparseTable, SparseTable,
+                    make_table)
+
+__all__ = ["PSServer", "PSClient", "Communicator", "DenseTable",
+           "SparseTable", "GeoSparseTable", "BarrierTable", "make_table",
+           "SparseEmbedding"]
+
+
+class SparseEmbedding:
+    """PS-backed embedding for vocabularies too large for device HBM.
+
+    Reference analog: `lookup_table` with remote prefetch
+    (operators/distributed/parameter_prefetch.cc) + sparse push of
+    SelectedRows grads (fleet_wrapper.h push_sparse). Here the lookup is
+    an explicit pull/push pair around the jitted step, keeping the step
+    itself static-shaped and host-callback-free:
+
+        emb = ps.SparseEmbedding(client, table="w2v", dim=64)
+        rows = emb.pull(ids)              # paddle Tensor [n_unique, dim]
+        ...                               # use rows inside fwd/bwd
+        loss.backward()
+        emb.push_grad(rows)               # sends rows.grad for those ids
+
+    Duplicate ids in a batch are uniqued on pull; gather back to batch
+    positions happens on-device via the returned `index` (so the TPU does
+    the [n_unique, dim] -> [batch, dim] gather, and the reverse scatter
+    lands in rows.grad through the normal tape).
+    """
+
+    def __init__(self, client, table: str, dim: int,
+                 communicator: Communicator | None = None):
+        self.client = client
+        self.table = table
+        self.dim = int(dim)
+        self.communicator = communicator
+        self._last_ids = None
+
+    def pull(self, ids):
+        """ids: int array-like, any shape -> (rows Tensor [n_unique, dim]
+        with stop_gradient=False, index int Tensor of ids.shape mapping
+        each position to its row)."""
+        from ... import core
+        ids_np = np.asarray(getattr(ids, "numpy", lambda: ids)(),
+                            dtype=np.int64)
+        uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
+        rows_np = self.client.pull_sparse(self.table, uniq)
+        self._last_ids = uniq
+        rows = core.Tensor(rows_np, stop_gradient=False)
+        index = core.Tensor(inv.reshape(ids_np.shape).astype(np.int64))
+        return rows, index
+
+    def push_grad(self, rows):
+        """Push rows.grad (from the last backward) for the pulled ids."""
+        if self._last_ids is None:
+            raise RuntimeError("push_grad before pull")
+        if rows.grad is None:
+            raise RuntimeError(
+                "rows has no grad — call loss.backward() first (and use "
+                "the rows tensor inside the loss computation)")
+        g = np.asarray(rows.grad.numpy() if hasattr(rows.grad, "numpy")
+                       else rows.grad, np.float32)
+        if self.communicator is not None:
+            self.communicator.push_sparse(self.table, self._last_ids, g)
+        else:
+            self.client.push_sparse_grad(self.table, self._last_ids, g)
+        self._last_ids = None

@@ -1,0 +1,199 @@
+"""PSClient + Communicator — the worker side of the PS stack.
+
+Analogs: reference N21 PSClient (distributed/service/ps_client.h:
+pull_dense/push_dense/pull_sparse/push_sparse futures), N20 row splitting
+across servers (operators/distributed/parameter_send.cc: rows hashed to
+sections, one RPC per server) and the background-send Communicator
+(operators/distributed/communicator.cc: AsyncCommunicator merges grads in
+queues and flushes every send_wait_times; GeoCommunicator pushes deltas).
+
+Sharding: sparse ids are hashed id % n_servers (same mod rule the
+reference uses for section splitting); dense tables live whole on
+hash(name) % n_servers (dense params here are small relative to the
+sparse vocab — the TPU step owns the real dense math).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from .rpc import Connection
+
+__all__ = ["PSClient", "Communicator"]
+
+
+class PSClient:
+    def __init__(self, server_endpoints):
+        if isinstance(server_endpoints, str):
+            server_endpoints = server_endpoints.split(",")
+        self.endpoints = list(server_endpoints)
+        self._conns = [Connection(ep) for ep in self.endpoints]
+
+    @property
+    def n_servers(self):
+        return len(self._conns)
+
+    def _dense_conn(self, table):
+        # crc32, NOT hash(): str hash is per-process randomized, and every
+        # worker must route a dense table to the same server
+        return self._conns[zlib.crc32(table.encode()) % self.n_servers]
+
+    # --------------------------------------------------------------- dense
+    def pull_dense(self, table):
+        return self._dense_conn(table).call("pull_dense", table=table)
+
+    def push_dense_grad(self, table, grad):
+        self._dense_conn(table).call("push_dense_grad", table=table,
+                                     grad=np.asarray(grad, np.float32))
+
+    def set_dense(self, table, value):
+        self._dense_conn(table).call("set_dense", table=table,
+                                     value=np.asarray(value, np.float32))
+
+    # -------------------------------------------------------------- sparse
+    def _shard(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        owner = ids % self.n_servers
+        return ids, owner
+
+    def pull_sparse(self, table, ids):
+        """Gather rows for (possibly duplicated) ids; returns
+        [len(ids), dim] in input order."""
+        ids, owner = self._shard(ids)
+        out = None
+        for s in range(self.n_servers):
+            mask = owner == s
+            if not mask.any():
+                continue
+            rows = self._conns[s].call("pull_sparse", table=table,
+                                       ids=ids[mask])
+            if out is None:
+                out = np.empty((len(ids), rows.shape[1]), np.float32)
+            out[mask] = rows
+        if out is None:
+            raise ValueError("pull_sparse with zero ids")
+        return out
+
+    def push_sparse_grad(self, table, ids, grads):
+        ids, owner = self._shard(ids)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        for s in range(self.n_servers):
+            mask = owner == s
+            if mask.any():
+                self._conns[s].call("push_sparse_grad", table=table,
+                                    ids=ids[mask], grads=grads[mask])
+
+    def push_sparse_delta(self, table, ids, deltas):
+        ids, owner = self._shard(ids)
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), -1)
+        for s in range(self.n_servers):
+            mask = owner == s
+            if mask.any():
+                self._conns[s].call("push_sparse_delta", table=table,
+                                    ids=ids[mask], deltas=deltas[mask])
+
+    # --------------------------------------------------------------- misc
+    def barrier(self, table, trainer_id, timeout=120.0):
+        # barrier table lives on server 0 (reference BarrierTable is
+        # likewise singular)
+        return self._conns[0].call("barrier", table=table,
+                                   trainer_id=trainer_id, timeout=timeout)
+
+    def table_state(self, table, server=0):
+        return self._conns[server].call("table_state", table=table)
+
+    def stop_servers(self):
+        for c in self._conns:
+            try:
+                c.call("stop")
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for c in self._conns:
+            c.close()
+
+
+class Communicator:
+    """Async gradient channel (reference communicator.cc AsyncCommunicator:
+    per-var bounded queues, a background thread that MERGES queued grads
+    — MergeAdd for sparse — and sends every batch; workers never block on
+    the push). flush() drains synchronously; used at barriers/epoch ends.
+    """
+
+    def __init__(self, client: PSClient, send_every=4, max_queue=64):
+        self._client = client
+        self._send_every = int(send_every)
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- worker
+    def push_sparse(self, table, ids, grads):
+        self._q.put(("sparse", table, np.asarray(ids, np.int64).reshape(-1),
+                     np.asarray(grads, np.float32)))
+
+    def push_dense(self, table, grad):
+        self._q.put(("dense", table, None, np.asarray(grad, np.float32)))
+
+    # --------------------------------------------------------- background
+    def _loop(self):
+        # drain-tracking rides the queue's task accounting: task_done only
+        # fires AFTER a batch lands on the servers, so flush()'s join-style
+        # wait can't slip past a produced-but-unsent item (an Event toggled
+        # on a momentary empty poll could)
+        pending = []
+        while not self._stop.is_set() or not self._q.empty() or pending:
+            try:
+                pending.append(self._q.get(timeout=0.05))
+            except queue.Empty:
+                pass
+            if pending and (len(pending) >= self._send_every
+                            or self._stop.is_set() or self._q.empty()):
+                try:
+                    self._send_merged(pending)
+                finally:
+                    for _ in pending:
+                        self._q.task_done()
+                pending = []
+
+    def _send_merged(self, items):
+        sparse: dict[str, list] = {}
+        dense: dict[str, np.ndarray] = {}
+        for kind, table, ids, grads in items:
+            if kind == "sparse":
+                sparse.setdefault(table, []).append((ids, grads))
+            else:
+                if table in dense:
+                    dense[table] = dense[table] + grads
+                else:
+                    dense[table] = grads
+        for table, parts in sparse.items():
+            ids = np.concatenate([p[0] for p in parts])
+            grads = np.concatenate(
+                [p[1].reshape(len(p[0]), -1) for p in parts])
+            # merge duplicates before the wire (reference MergeAdd)
+            uniq, inv = np.unique(ids, return_inverse=True)
+            merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
+            np.add.at(merged, inv, grads)
+            self._client.push_sparse_grad(table, uniq, merged)
+        for table, grad in dense.items():
+            self._client.push_dense_grad(table, grad)
+
+    def flush(self, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        with self._q.all_tasks_done:
+            while self._q.unfinished_tasks:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("communicator failed to drain")
+                self._q.all_tasks_done.wait(remaining)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=60.0)

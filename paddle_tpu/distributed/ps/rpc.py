@@ -1,0 +1,160 @@
+"""Framed request/response transport for the PS stack.
+
+The reference runs its parameter server over brpc (N21
+distributed/service/brpc_ps_server.cc) or gRPC (N20
+operators/distributed/grpc/). Neither is warranted here: PS traffic is a
+handful of large tensors per step between trusted cluster processes, so
+the transport is a length-prefixed binary frame over TCP — numpy payloads
+ride as raw buffers (zero-copy out of the socket), metadata as a small
+pickled header. One thread per live connection on the server; clients
+hold one persistent connection per server and serialize calls on it.
+"""
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["send_msg", "recv_msg", "Connection", "serve"]
+
+_HDR = struct.Struct("!Q")
+
+
+def _pack(obj) -> bytes:
+    """Pickle with numpy arrays extracted to raw out-of-band buffers
+    (pickle-5 semantics) so big tensors aren't copied through the
+    pickler."""
+    buffers = []
+    payload = pickle.dumps(obj, protocol=5,
+                           buffer_callback=lambda b: buffers.append(b))
+    parts = [payload] + [bytes(b) for b in buffers]
+    head = pickle.dumps([len(p) for p in parts])
+    return _HDR.pack(len(head)) + head + b"".join(parts)
+
+
+def _unpack(data: bytes):
+    n = _HDR.unpack_from(data)[0]
+    sizes = pickle.loads(data[_HDR.size:_HDR.size + n])
+    off = _HDR.size + n
+    parts = []
+    for s in sizes:
+        parts.append(data[off:off + s])
+        off += s
+    return pickle.loads(parts[0], buffers=parts[1:])
+
+
+def send_msg(sock: socket.socket, obj) -> None:
+    data = _pack(obj)
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def recv_msg(sock: socket.socket):
+    head = _recv_exact(sock, _HDR.size)
+    if head is None:
+        return None
+    (n,) = _HDR.unpack(head)
+    data = _recv_exact(sock, n)
+    if data is None:
+        return None
+    return _unpack(data)
+
+
+def _recv_exact(sock, n):
+    buf = io.BytesIO()
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            return None
+        buf.write(chunk)
+        got += len(chunk)
+    return buf.getvalue()
+
+
+class Connection:
+    """Client side: one persistent socket, calls serialized by a lock.
+    Connect retries briefly — workers routinely race the server's bind at
+    job start (the reference's brpc channel does the same via
+    connect_timeout + retry policy)."""
+
+    def __init__(self, endpoint: str, timeout=120.0, connect_retry_s=30.0):
+        import time
+        host, port = endpoint.rsplit(":", 1)
+        deadline = time.monotonic() + connect_retry_s
+        while True:
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=timeout)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def call(self, method: str, **kwargs):
+        with self._lock:
+            send_msg(self._sock, {"method": method, **kwargs})
+            reply = recv_msg(self._sock)
+        if reply is None:
+            raise ConnectionError(f"server closed during {method!r}")
+        if reply.get("error"):
+            raise RuntimeError(f"ps server error in {method!r}: "
+                               f"{reply['error']}")
+        return reply.get("result")
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def serve(endpoint: str, handler, stop_event: threading.Event):
+    """Accept loop: one daemon thread per connection, each dispatching
+    framed requests to handler(method, kwargs) until the peer closes or
+    stop_event fires. Returns the bound port (endpoint may say :0)."""
+    host, port = endpoint.rsplit(":", 1)
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, int(port)))
+    srv.listen(128)
+    srv.settimeout(0.2)
+    bound = srv.getsockname()[1]
+
+    def _conn_loop(conn):
+        conn.settimeout(None)
+        try:
+            while not stop_event.is_set():
+                req = recv_msg(conn)
+                if req is None:
+                    break
+                method = req.pop("method")
+                try:
+                    result = handler(method, req)
+                    send_msg(conn, {"result": result})
+                except Exception as e:  # noqa: BLE001 — reported to peer
+                    send_msg(conn, {"error": f"{type(e).__name__}: {e}"})
+        finally:
+            conn.close()
+
+    def _accept_loop():
+        with srv:
+            while not stop_event.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                threading.Thread(target=_conn_loop, args=(conn,),
+                                 daemon=True).start()
+
+    t = threading.Thread(target=_accept_loop, daemon=True)
+    t.start()
+    return bound, t

@@ -1,0 +1,94 @@
+"""PSServer — hosts tables, serves pull/push.
+
+Analog of reference N21 PSServer (distributed/service/brpc_ps_server.cc:
+service handlers pull_dense/push_dense_param/push_sparse/...; table map
+from ps.proto) and N20 listen_and_serv_op (operators/pscore/
+listen_and_serv_op.cc server loop). The server is compute-free: update
+rules live in the tables (table.py), the RPC layer is rpc.py.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .rpc import serve
+from .table import BarrierTable, DenseTable, GeoSparseTable, SparseTable, \
+    make_table
+
+__all__ = ["PSServer"]
+
+
+class PSServer:
+    def __init__(self, endpoint="127.0.0.1:0", tables: dict | None = None):
+        """tables: name -> table spec dict (see table.make_table) or a
+        ready table object."""
+        self._tables = {}
+        for name, spec in (tables or {}).items():
+            self.add_table(name, spec)
+        self._stop = threading.Event()
+        self._endpoint = endpoint
+        self._thread = None
+        self.port = None
+
+    # -------------------------------------------------------------- admin
+    def add_table(self, name, spec):
+        self._tables[name] = spec if not isinstance(spec, dict) \
+            else make_table(spec)
+
+    def table(self, name):
+        return self._tables[name]
+
+    def start(self):
+        self.port, self._thread = serve(self._endpoint, self._handle,
+                                        self._stop)
+        host = self._endpoint.rsplit(":", 1)[0]
+        self.endpoint = f"{host}:{self.port}"
+        return self.endpoint
+
+    def run(self):
+        """Block until a peer calls stop (reference fleet.run_server)."""
+        if self._thread is None:
+            self.start()
+        self._stop.wait()
+
+    def shutdown(self):
+        self._stop.set()
+
+    # ----------------------------------------------------------- handlers
+    def _handle(self, method, req):
+        if method == "stop":
+            self._stop.set()
+            return True
+        if method == "ping":
+            return "pong"
+        if method == "list_tables":
+            return {n: type(t).__name__ for n, t in self._tables.items()}
+        t = self._tables[req.pop("table")]
+        if method == "pull_dense":
+            return t.pull()
+        if method == "push_dense_grad":
+            t.push_grad(req["grad"])
+            return True
+        if method == "set_dense":
+            t.set(req["value"])
+            return True
+        if method == "pull_sparse":
+            return t.pull(req["ids"])
+        if method == "push_sparse_grad":
+            t.push_grad(req["ids"], req["grads"])
+            return True
+        if method == "push_sparse_delta":
+            t.push_delta(req["ids"], req["deltas"])
+            return True
+        if method == "barrier":
+            return t.wait(req["trainer_id"], req.get("timeout", 120.0))
+        if method == "table_state":
+            return t.state()
+        if method == "load_table_state":
+            t.load_state(req["state"])
+            return True
+        if method == "table_size":
+            return len(t) if isinstance(t, SparseTable) else \
+                int(np.prod(t.param.shape))
+        raise ValueError(f"unknown PS method {method!r}")

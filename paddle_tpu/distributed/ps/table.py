@@ -1,0 +1,282 @@
+"""Parameter-server tables.
+
+TPU-native re-design of the reference PS table layer (N21:
+paddle/fluid/distributed/table/ — CommonDenseTable common_dense_table.cc,
+CommonSparseTable common_sparse_table.cc, SparseGeoTable
+sparse_geo_table.cc, BarrierTable barrier_table.cc; accessor update rules
+from table/depends/sparse.h + the optimizer ops they mirror).
+
+Design deltas (SURVEY.md §2.1 N20-N22, hard part 5):
+- Tables are host-resident numpy state. The TPU never sees the full
+  (unbounded) sparse vocab: workers pull just the rows a batch touches,
+  the jitted step computes row gradients, and workers push those rows
+  back. That is the "host-KV + gather" sharded-embedding design — the
+  MXU works on dense [n_ids, dim] blocks, the hash map stays host-side.
+- Update rules run server-side on push (reference "accessor" semantics),
+  so async workers never hold optimizer slots for sparse params.
+- Rows are created lazily on first touch (reference large_scale_kv.h
+  auto-grown entries) with per-table initializers.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["DenseTable", "SparseTable", "GeoSparseTable", "BarrierTable",
+           "make_table"]
+
+
+# ---------------------------------------------------------------- accessors
+
+def _sgd_init(shape, dtype):
+    return {}
+
+
+def _sgd_apply(param, grad, slots, lr):
+    param -= lr * grad
+    return param
+
+
+def _adagrad_init(shape, dtype):
+    return {"moment": np.zeros(shape, dtype)}
+
+
+def _adagrad_apply(param, grad, slots, lr, eps=1e-6):
+    m = slots["moment"]
+    m += grad * grad
+    param -= lr * grad / (np.sqrt(m) + eps)
+    return param
+
+
+def _adam_init(shape, dtype):
+    return {"m": np.zeros(shape, dtype), "v": np.zeros(shape, dtype),
+            "t": np.zeros(shape[:-1] + (1,), np.int64) if len(shape) > 1
+            else np.zeros((1,), np.int64)}
+
+
+def _adam_apply(param, grad, slots, lr, beta1=0.9, beta2=0.999, eps=1e-8):
+    slots["t"] += 1
+    t = slots["t"]
+    m, v = slots["m"], slots["v"]
+    m *= beta1
+    m += (1 - beta1) * grad
+    v *= beta2
+    v += (1 - beta2) * grad * grad
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    param -= lr * mhat / (np.sqrt(vhat) + eps)
+    return param
+
+
+_ACCESSORS = {
+    "sgd": (_sgd_init, _sgd_apply),
+    "adagrad": (_adagrad_init, _adagrad_apply),
+    "adam": (_adam_init, _adam_apply),
+}
+
+
+def _initializer(kind, dim, seed):
+    rng = np.random.RandomState(seed)
+    if kind == "zeros":
+        return lambda n: np.zeros((n, dim), np.float32)
+    if kind == "uniform":
+        scale = 1.0 / np.sqrt(dim)
+        return lambda n: rng.uniform(-scale, scale, (n, dim)).astype(
+            np.float32)
+    if kind == "normal":
+        return lambda n: (rng.randn(n, dim) * 0.01).astype(np.float32)
+    raise ValueError(f"unknown initializer {kind!r}")
+
+
+# ------------------------------------------------------------------ tables
+
+class DenseTable:
+    """Whole-parameter block with a server-side update rule (reference
+    common_dense_table.cc: values_ + per-rule slots, pull_dense returning
+    the block, push_dense applying sgd/adam/"sum")."""
+
+    def __init__(self, shape, optimizer="sgd", lr=0.01, init="zeros",
+                 seed=0):
+        shape = tuple(int(s) for s in shape)
+        if init == "zeros":
+            self.param = np.zeros(shape, np.float32)
+        else:
+            rng = np.random.RandomState(seed)
+            self.param = (rng.randn(*shape) *
+                          (0.01 if init == "normal"
+                           else 1.0 / np.sqrt(shape[-1]))).astype(np.float32)
+        slot_init, self._apply = _ACCESSORS[optimizer]
+        self._slots = slot_init(shape, np.float32)
+        self.lr = float(lr)
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self.param.copy()
+
+    def push_grad(self, grad):
+        grad = np.asarray(grad, np.float32).reshape(self.param.shape)
+        with self._lock:
+            self.param = self._apply(self.param, grad, self._slots, self.lr)
+
+    def set(self, value):
+        with self._lock:
+            self.param = np.asarray(value, np.float32).reshape(
+                self.param.shape)
+
+    def state(self):
+        with self._lock:
+            return {"param": self.param.copy(),
+                    "slots": {k: v.copy() for k, v in self._slots.items()},
+                    "lr": self.lr}
+
+    def load_state(self, st):
+        with self._lock:
+            self.param = np.asarray(st["param"], np.float32)
+            self._slots = {k: np.asarray(v) for k, v in st["slots"].items()}
+            self.lr = float(st.get("lr", self.lr))
+
+
+class SparseTable:
+    """Auto-growing id -> row KV store (reference common_sparse_table.cc +
+    operators/distributed/large_scale_kv.h: rows materialize on first
+    access; pull_sparse gathers, push_sparse applies the accessor rule to
+    just the touched rows). ids are arbitrary int64 — no dense vocab bound.
+    """
+
+    def __init__(self, dim, optimizer="adagrad", lr=0.05, init="uniform",
+                 seed=0):
+        self.dim = int(dim)
+        self._rows: dict[int, np.ndarray] = {}
+        self._row_slots: dict[int, dict] = {}
+        slot_init, self._apply = _ACCESSORS[optimizer]
+        self._slot_init = lambda: slot_init((self.dim,), np.float32)
+        self._init_rows = _initializer(init, self.dim, seed)
+        self.lr = float(lr)
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        return len(self._rows)
+
+    def _ensure(self, ids):
+        missing = [i for i in ids if i not in self._rows]
+        if missing:
+            fresh = self._init_rows(len(missing))
+            for k, i in enumerate(missing):
+                self._rows[i] = fresh[k]
+                self._row_slots[i] = self._slot_init()
+
+    def pull(self, ids):
+        ids = [int(i) for i in np.asarray(ids).reshape(-1)]
+        with self._lock:
+            self._ensure(ids)
+            return np.stack([self._rows[i] for i in ids]) if ids \
+                else np.zeros((0, self.dim), np.float32)
+
+    def push_grad(self, ids, grads):
+        """Duplicate ids in one push are accumulated first (reference
+        MergeAdd over SelectedRows before the rule applies)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(merged, inv, grads)
+        with self._lock:
+            self._ensure(int(i) for i in uniq)
+            for k, i in enumerate(uniq):
+                i = int(i)
+                self._rows[i] = self._apply(
+                    self._rows[i], merged[k], self._row_slots[i], self.lr)
+
+    def state(self):
+        with self._lock:
+            ids = np.fromiter(self._rows.keys(), np.int64,
+                              count=len(self._rows))
+            vals = np.stack([self._rows[int(i)] for i in ids]) if len(ids) \
+                else np.zeros((0, self.dim), np.float32)
+            return {"ids": ids, "values": vals, "lr": self.lr,
+                    "slots": {int(i): {k: v.copy() for k, v in s.items()}
+                              for i, s in self._row_slots.items()}}
+
+    def load_state(self, st):
+        with self._lock:
+            self._rows = {int(i): np.asarray(v, np.float32)
+                          for i, v in zip(st["ids"], st["values"])}
+            self._row_slots = {
+                int(i): {k: np.asarray(v) for k, v in s.items()}
+                for i, s in st.get("slots", {}).items()}
+            for i in self._rows:
+                self._row_slots.setdefault(i, self._slot_init())
+            self.lr = float(st.get("lr", self.lr))
+
+
+class GeoSparseTable(SparseTable):
+    """Geo-SGD variant (reference sparse_geo_table.cc + communicator.cc
+    GeoCommunicator): workers train LOCAL embedding copies and
+    periodically push the delta vs their last sync; the server folds
+    deltas in and hands back fresh rows. push is plain addition — the
+    worker already applied its own optimizer."""
+
+    def __init__(self, dim, lr=1.0, init="uniform", seed=0):
+        super().__init__(dim, optimizer="sgd", lr=lr, init=init, seed=seed)
+
+    def push_delta(self, ids, deltas):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), self.dim)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), self.dim), np.float32)
+        np.add.at(merged, inv, deltas)
+        with self._lock:
+            self._ensure(int(i) for i in uniq)
+            for k, i in enumerate(uniq):
+                self._rows[int(i)] = self._rows[int(i)] + merged[k]
+
+
+class BarrierTable:
+    """Worker-count barrier (reference barrier_table.cc: trigger when all
+    trainers arrive)."""
+
+    def __init__(self, trainer_num):
+        self.trainer_num = int(trainer_num)
+        self._cond = threading.Condition()
+        self._arrived = set()
+        self._generation = 0
+
+    def wait(self, trainer_id, timeout=120.0):
+        with self._cond:
+            gen = self._generation
+            self._arrived.add(int(trainer_id))
+            if len(self._arrived) >= self.trainer_num:
+                self._arrived.clear()
+                self._generation += 1
+                self._cond.notify_all()
+                return True
+            ok = self._cond.wait_for(lambda: self._generation > gen,
+                                     timeout=timeout)
+            if not ok:
+                raise TimeoutError(
+                    f"barrier: {len(self._arrived)}/{self.trainer_num} "
+                    f"trainers after {timeout}s")
+            return True
+
+
+def make_table(spec: dict):
+    """Build a table from a config dict (reference ps.proto TableParameter:
+    table type + accessor + common params)."""
+    kind = spec.get("type", "sparse")
+    if kind == "dense":
+        return DenseTable(spec["shape"], spec.get("optimizer", "sgd"),
+                          spec.get("lr", 0.01), spec.get("init", "zeros"),
+                          spec.get("seed", 0))
+    if kind == "sparse":
+        return SparseTable(spec["dim"], spec.get("optimizer", "adagrad"),
+                           spec.get("lr", 0.05), spec.get("init", "uniform"),
+                           spec.get("seed", 0))
+    if kind == "geo_sparse":
+        return GeoSparseTable(spec["dim"], spec.get("lr", 1.0),
+                              spec.get("init", "uniform"),
+                              spec.get("seed", 0))
+    if kind == "barrier":
+        return BarrierTable(spec.get("trainer_num", 1))
+    raise ValueError(f"unknown table type {kind!r}")

@@ -26,6 +26,13 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+import numpy as np
+
+_Z = np.int32(0)  # index-map zero: a Python literal 0 traces as i64 under
+                  # jax_enable_x64 and Mosaic rejects i64 index returns
+                  # (numpy scalar, not jnp — index maps may not capture
+                  # constant Arrays)
+
 NEG_INF = -1e9  # finite "masked" value: keeps running-max finite even for
                 # fully-padded rows (exp(NEG_INF - NEG_INF) stays sane)
 
@@ -75,7 +82,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if bias_ref is not None:
-            s = s + bias_ref[:]                    # [1, bk] broadcasts
+            s = s + bias_ref[0]                    # [1, bk] broadcasts
         if causal:
             s = _causal_mask(s, iq, ik, bq, bk, off)
 
@@ -109,15 +116,21 @@ def _fwd(q, k, v, bias, scale, causal, heads, bq, bk):
     grid = (bh, nq, nk)
 
     in_specs = [
-        pl.BlockSpec((1, bq, d), lambda ib, iq, ik: (ib, iq, 0)),
-        pl.BlockSpec((1, bk, d), lambda ib, iq, ik: (ib, ik, 0)),
-        pl.BlockSpec((1, bk, d), lambda ib, iq, ik: (ib, ik, 0)),
+        pl.BlockSpec((1, bq, d), lambda ib, iq, ik: (ib, iq, _Z)),
+        pl.BlockSpec((1, bk, d), lambda ib, iq, ik: (ib, ik, _Z)),
+        pl.BlockSpec((1, bk, d), lambda ib, iq, ik: (ib, ik, _Z)),
     ]
     args = [q, k, v]
     if bias is not None:
-        in_specs.append(
-            pl.BlockSpec((1, bk), lambda ib, iq, ik: (ib // heads, ik)))
-        args.append(bias)
+        # [b*h, 1, sk]: tiled per head so the index map is pure indexing
+        # (arithmetic like ib // heads recurses in this jax's index-map
+        # tracing), and the singleton row keeps the block's sublane dim
+        # equal to the array's (TPU blocks must be (8,128)-divisible or
+        # full-dim)
+        in_specs.append(pl.BlockSpec(
+            (1, 1, bk), lambda ib, iq, ik: (ib, _Z, ik)))
+        args.append(jnp.repeat(
+            bias.reshape(bias.shape[0], 1, bias.shape[-1]), heads, axis=0))
 
     opts = dict(scale=scale, causal=causal, bq=bq, bk=bk, nk=nk,
                 off=sk - sq)
@@ -132,12 +145,15 @@ def _fwd(q, k, v, bias, scale, causal, heads, bq, bk):
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda ib, iq, ik: (ib, iq, 0)),
-            pl.BlockSpec((1, bq), lambda ib, iq, ik: (ib, iq)),
+            pl.BlockSpec((1, bq, d), lambda ib, iq, ik: (ib, iq, _Z)),
+            # lse rides as [bh, sq, 1]: trailing singleton == array dim, and
+            # the sublane dim bq is 8-divisible — legal TPU tiling, unlike a
+            # (1, bq) block over [bh, sq]
+            pl.BlockSpec((1, bq, 1), lambda ib, iq, ik: (ib, iq, _Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             _vmem((bq, 1), jnp.float32),
@@ -146,7 +162,7 @@ def _fwd(q, k, v, bias, scale, causal, heads, bq, bk):
         ],
         interpret=_interpret(),
     )(*args)
-    return out, lse
+    return out, lse[..., 0]
 
 
 def _vmem(shape, dtype):
@@ -171,7 +187,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if bias_ref is not None:
-            s = s + bias_ref[:]
+            s = s + bias_ref[0]
         if causal:
             s = _causal_mask(s, iq, ik, bq, bk, off)
 
@@ -210,7 +226,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if bias_ref is not None:
-            s = s + bias_ref[:]
+            s = s + bias_ref[0]
         if causal:
             s = _causal_mask(s, iq, ik, bq, bk, off)
 
@@ -244,27 +260,32 @@ def _bwd(q, k, v, bias, out, lse, do, scale, causal, heads, bq, bk):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = sq // bq, sk // bk
+    # lse/delta ride as [bh, sq, 1] and bias as [b, 1, sk] for legal TPU
+    # block tiling (see _fwd)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                        # [bh, sq]
+                    axis=-1)[..., None]             # [bh, sq, 1]
+    lse3 = lse[..., None]                           # [bh, sq, 1]
+    bias3 = None if bias is None else jnp.repeat(
+        bias.reshape(bias.shape[0], 1, bias.shape[-1]), heads, axis=0)
 
     def specs(extra_bias):
         base = [
-            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, i, 0)),   # q
-            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, j, 0)),   # k
-            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, j, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, i, _Z)),   # q
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, j, _Z)),   # k
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, j, _Z)),   # v
         ]
         if extra_bias:
-            base.append(
-                pl.BlockSpec((1, bk), lambda ib, i, j: (ib // heads, j)))
+            base.append(pl.BlockSpec(
+                (1, 1, bk), lambda ib, i, j: (ib, _Z, j)))
         base += [
-            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, i, 0)),   # do
-            pl.BlockSpec((1, bq), lambda ib, i, j: (ib, i)),         # lse
-            pl.BlockSpec((1, bq), lambda ib, i, j: (ib, i)),         # delta
+            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, i, _Z)),   # do
+            pl.BlockSpec((1, bq, 1), lambda ib, i, j: (ib, i, _Z)),   # lse
+            pl.BlockSpec((1, bq, 1), lambda ib, i, j: (ib, i, _Z)),   # delta
         ]
         return base
 
-    args = ([q, k, v, bias] if bias is not None else [q, k, v]) \
-        + [do, lse, delta]
+    args = ([q, k, v, bias3] if bias is not None else [q, k, v]) \
+        + [do, lse3, delta]
 
     # ---- dq: grid (bh, nq, nk), k-blocks innermost -----------------------
     dq_kernel = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -279,7 +300,7 @@ def _bwd(q, k, v, bias, out, lse, do, scale, causal, heads, bq, bk):
         dq_kernel,
         grid=(bh, nq, nk),
         in_specs=specs(bias is not None),
-        out_specs=pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, i, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, i, _Z)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[_vmem((bq, d), jnp.float32)],
         interpret=_interpret(),
@@ -288,17 +309,17 @@ def _bwd(q, k, v, bias, out, lse, do, scale, causal, heads, bq, bk):
     # ---- dk/dv: grid (bh, nk, nq), q-blocks innermost --------------------
     def specs_kv(extra_bias):
         base = [
-            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, j, 0)),   # q
-            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, 0)),   # k
-            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, 0)),   # v
+            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, j, _Z)),   # q
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, _Z)),   # k
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, _Z)),   # v
         ]
         if extra_bias:
-            base.append(
-                pl.BlockSpec((1, bk), lambda ib, i, j: (ib // heads, i)))
+            base.append(pl.BlockSpec(
+                (1, 1, bk), lambda ib, i, j: (ib, _Z, i)))
         base += [
-            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, j, 0)),   # do
-            pl.BlockSpec((1, bq), lambda ib, i, j: (ib, j)),         # lse
-            pl.BlockSpec((1, bq), lambda ib, i, j: (ib, j)),         # delta
+            pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, j, _Z)),   # do
+            pl.BlockSpec((1, bq, 1), lambda ib, i, j: (ib, j, _Z)),   # lse
+            pl.BlockSpec((1, bq, 1), lambda ib, i, j: (ib, j, _Z)),   # delta
         ]
         return base
 
@@ -317,8 +338,8 @@ def _bwd(q, k, v, bias, out, lse, do, scale, causal, heads, bq, bk):
         grid=(bh, nk, nq),
         in_specs=specs_kv(bias is not None),
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, _Z)),
+            pl.BlockSpec((1, bk, d), lambda ib, i, j: (ib, i, _Z)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .flash_attention import NEG_INF, _interpret, _vmem
+from .flash_attention import NEG_INF, _Z, _interpret, _vmem
 
 
 def _pick(n, target):
@@ -80,14 +80,14 @@ def _fwd(h, w, b, y, ignore, bn, bv):
     nv = pl.cdiv(vocab, bv)
     args = [h.reshape(1, n, hd), w.reshape(1, vocab, hd)]
     in_specs = [
-        pl.BlockSpec((1, bn, hd), lambda i, j: (0, i, 0)),
-        pl.BlockSpec((1, bv, hd), lambda i, j: (0, j, 0)),
+        pl.BlockSpec((1, bn, hd), lambda i, j: (_Z, i, _Z)),
+        pl.BlockSpec((1, bv, hd), lambda i, j: (_Z, j, _Z)),
     ]
     if b is not None:
         args.append(b.reshape(1, vocab))
-        in_specs.append(pl.BlockSpec((1, bv), lambda i, j: (0, j)))
+        in_specs.append(pl.BlockSpec((1, bv), lambda i, j: (_Z, j)))
     args.append(y.reshape(1, n))
-    in_specs.append(pl.BlockSpec((1, bn), lambda i, j: (0, i)))
+    in_specs.append(pl.BlockSpec((1, bn), lambda i, j: (_Z, i)))
 
     opts = dict(bn=bn, bv=bv, nv=nv, vocab=vocab, ignore=ignore)
     if b is not None:
@@ -100,8 +100,8 @@ def _fwd(h, w, b, y, ignore, bn, bv):
         kernel,
         grid=(n // bn, nv),
         in_specs=in_specs,
-        out_specs=[pl.BlockSpec((1, bn), lambda i, j: (0, i)),
-                   pl.BlockSpec((1, bn), lambda i, j: (0, i))],
+        out_specs=[pl.BlockSpec((1, bn), lambda i, j: (_Z, i)),
+                   pl.BlockSpec((1, bn), lambda i, j: (_Z, i))],
         out_shape=[jax.ShapeDtypeStruct((1, n), jnp.float32),
                    jax.ShapeDtypeStruct((1, n), jnp.float32)],
         scratch_shapes=[_vmem((bn, 1), jnp.float32),
@@ -215,9 +215,9 @@ def _bwd(h, w, b, y, lse, g, ignore, bn, bv):
     dh = pl.pallas_call(
         dh_kernel,
         grid=(nn_, nv),
-        in_specs=base_specs(lambda i, j: (0, i, 0), lambda i, j: (0, j, 0),
-                            lambda i, j: (0, j), lambda i, j: (0, i)),
-        out_specs=pl.BlockSpec((1, bn, hd), lambda i, j: (0, i, 0)),
+        in_specs=base_specs(lambda i, j: (_Z, i, _Z), lambda i, j: (_Z, j, _Z),
+                            lambda i, j: (_Z, j), lambda i, j: (_Z, i)),
+        out_specs=pl.BlockSpec((1, bn, hd), lambda i, j: (_Z, i, _Z)),
         out_shape=jax.ShapeDtypeStruct((1, n, hd), h.dtype),
         scratch_shapes=[_vmem((bn, hd), jnp.float32)],
         interpret=_interpret(),
@@ -237,10 +237,10 @@ def _bwd(h, w, b, y, lse, g, ignore, bn, bv):
         dw_kernel,
         grid=(1, nv, nn_),
         in_specs=base_specs(
-            lambda z, j, i: (0, i, 0), lambda z, j, i: (0, j, 0),
-            lambda z, j, i: (0, j), lambda z, j, i: (0, i)),
-        out_specs=[pl.BlockSpec((1, bv, hd), lambda z, j, i: (0, j, 0)),
-                   pl.BlockSpec((1, bv), lambda z, j, i: (0, j))],
+            lambda z, j, i: (_Z, i, _Z), lambda z, j, i: (_Z, j, _Z),
+            lambda z, j, i: (_Z, j), lambda z, j, i: (_Z, i)),
+        out_specs=[pl.BlockSpec((1, bv, hd), lambda z, j, i: (_Z, j, _Z)),
+                   pl.BlockSpec((1, bv), lambda z, j, i: (_Z, j))],
         out_shape=[jax.ShapeDtypeStruct((1, vocab, hd), w.dtype),
                    jax.ShapeDtypeStruct((1, vocab), jnp.float32)],
         scratch_shapes=[_vmem((bv, hd), jnp.float32),

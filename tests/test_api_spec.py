@@ -6,11 +6,14 @@ import os
 import sys
 
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
 def test_api_surface_matches_spec():
-    sys.path.insert(0, "/root/repo/tools")
+    sys.path.insert(0, os.path.join(REPO, "tools"))
     import gen_api_spec
     live = gen_api_spec.collect()
-    with open("/root/repo/API.spec") as f:
+    with open(os.path.join(REPO, "API.spec")) as f:
         committed = f.read()
     if live != committed:
         live_set = set(live.splitlines())

@@ -53,13 +53,22 @@ def collect():
         names = getattr(mod, "__all__", None)
         if names is None:
             names = [n for n in dir(mod) if not n.startswith("_")]
+        # PEP-562 lazy attributes are invisible to dir() until first touch,
+        # which would make the snapshot depend on import order; modules
+        # declare them in __all_lazy__ so the surface is deterministic.
+        names = list(names) + list(getattr(mod, "__all_lazy__", ()))
+        lazy = set(getattr(mod, "__all_lazy__", ()))
         for name in sorted(set(names)):
             try:
                 obj = getattr(mod, name)
-            except AttributeError:
+            except (AttributeError, ImportError):
                 lines.append(f"{ns}.{name} MISSING")
                 continue
             if inspect.ismodule(obj):
+                if name in lazy:
+                    # a declared lazy NAME resolving to a module means a
+                    # submodule shadowed the public object — surface it
+                    lines.append(f"{ns}.{name} MISSING")
                 continue
             if inspect.isclass(obj):
                 lines.append(f"{ns}.{name} class{_sig(obj)}")
