@@ -124,7 +124,10 @@ def _ds_tile(h, w, b_ref, y, lse, g, iv, bn, bv, vocab, ignore):
         s = s + b_ref[:]
     col = iv * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
     p = jnp.exp(jnp.where(col < vocab, s, NEG_INF) - lse)
-    ds = p - jnp.where(col == y, 1.0, 0.0)
+    # (col == y).astype, NOT jnp.where(col == y, 1.0, 0.0): scalar-scalar
+    # where defaults to f64 under jax_enable_x64 and Mosaic aborts on any
+    # 64-bit kernel value (layout.h bitwidth check)
+    ds = p - (col == y).astype(jnp.float32)
     return ds * jnp.where(y != ignore, g, 0.0)     # [bn, bv] f32
 
 

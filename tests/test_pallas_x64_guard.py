@@ -1,0 +1,116 @@
+"""Mosaic bitwidth guard: no 64-bit value may appear inside a Pallas kernel.
+
+paddle_tpu enables jax_enable_x64 globally (int64 labels are first-class
+Paddle semantics), but the TPU Mosaic compiler aborts the whole process on
+any 64-bit kernel value (layout.h `has_single_bit(bitwidth_) && bitwidth_
+<= 32`). CPU interpret-mode tests can't catch that — the kernels run fine
+interpreted with f64 tiles — so this test traces every kernel entry point
+and walks the captured kernel jaxprs asserting every intermediate is
+<= 32-bit. This is the regression guard for the round-3 failure where
+`jnp.where(col == y, 1.0, 0.0)` (scalar-scalar where => f64 under x64)
+silently made BENCH fall back to the jnp paths.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu  # noqa: F401  — turns on jax_enable_x64
+# the package __init__ shadows the submodule names with the functions, so
+# fetch the modules from sys.modules via importlib
+import importlib
+
+fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+fc = importlib.import_module("paddle_tpu.ops.pallas.fused_ce")
+
+
+def _walk_jaxprs(jaxpr, found):
+    for eqn in jaxpr.eqns:
+        if "pallas_call" in eqn.primitive.name:
+            found.append(eqn.params["jaxpr"])
+        for v in eqn.params.values():
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None:
+                _walk_jaxprs(inner, found)
+            if isinstance(v, (list, tuple)):
+                for vv in v:
+                    inner = getattr(vv, "jaxpr", None)
+                    if inner is not None:
+                        _walk_jaxprs(inner, found)
+    return found
+
+
+def _assert_no_64bit(fn, *args):
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    kernels = _walk_jaxprs(jaxpr.jaxpr, [])
+    assert kernels, "no pallas_call found — test is vacuous"
+
+    def check(kj):
+        for eqn in kj.eqns:
+            for v in list(eqn.invars) + list(eqn.outvars):
+                if isinstance(v, jax.extend.core.Literal):
+                    # 64-bit scalar literals (e.g. the constant 0 in
+                    # `ref[0]`) lower to in-range index constants and are
+                    # fine; only *computed* 64-bit values trip Mosaic
+                    continue
+                aval = getattr(v, "aval", None)
+                if aval is None or not hasattr(aval, "dtype"):
+                    continue
+                if getattr(aval, "shape", ()) == ():
+                    # scalar weak-f64 constants (NEG_INF etc.) are folded
+                    # into their f32 consumers before Mosaic sees them; the
+                    # crash class is 64-bit *tiles* (r03: a [bn,bv] f64 from
+                    # a scalar-scalar jnp.where)
+                    continue
+                itemsize = jnp.dtype(aval.dtype).itemsize
+                assert itemsize <= 4, (
+                    f"64-bit value in pallas kernel: {eqn.primitive.name} "
+                    f"-> {aval.dtype}{getattr(aval, 'shape', ())} — Mosaic "
+                    "will SIGABRT on TPU (layout.h bitwidth check)")
+            for p in eqn.params.values():
+                inner = getattr(p, "jaxpr", None)
+                if inner is not None:
+                    check(inner)
+
+    for kj in kernels:
+        check(getattr(kj, "jaxpr", kj))
+
+
+@pytest.mark.parametrize("causal,with_bias", [(False, False), (True, False),
+                                              (False, True), (True, True)])
+def test_flash_attention_kernels_32bit(causal, with_bias):
+    b, h, s, d = 2, 2, 64, 32
+    q = jnp.zeros((b, h, s, d), jnp.bfloat16)
+    bias = jnp.zeros((b, s), jnp.float32) if with_bias else None
+
+    def fwd(q, k, v):
+        return fa.flash_attention(q, k, v, bias=bias, causal=causal)
+
+    _assert_no_64bit(fwd, q, q, q)
+
+    def bwd(q, k, v):
+        return jax.grad(lambda q, k, v: fwd(q, k, v).astype(
+            jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v)
+
+    _assert_no_64bit(bwd, q, q, q)
+
+
+@pytest.mark.parametrize("with_bias,ragged_vocab", [(True, True),
+                                                    (False, False)])
+def test_fused_ce_kernels_32bit(with_bias, ragged_vocab):
+    n, hd, v = 64, 32, (300 if ragged_vocab else 256)
+    h = jnp.zeros((n, hd), jnp.bfloat16)
+    w = jnp.zeros((v, hd), jnp.bfloat16)
+    b = jnp.zeros((v,), jnp.float32) if with_bias else None
+    y = jnp.zeros((n,), jnp.int32)
+
+    def fwd(h, w):
+        return fc.fused_linear_cross_entropy(h, w, b, y).sum()
+
+    _assert_no_64bit(fwd, h, w)
+
+    def bwd(h, w):
+        return jax.grad(fwd, argnums=(0, 1))(h, w)
+
+    _assert_no_64bit(bwd, h, w)
