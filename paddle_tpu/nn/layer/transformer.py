@@ -14,6 +14,10 @@ Analog of reference python/paddle/nn/layer/transformer.py
 """
 from __future__ import annotations
 
+import typing
+
+import jax
+
 from ... import ops
 from .. import functional as F
 from .. import initializer as I
@@ -22,9 +26,47 @@ from .container import LayerList
 from .layers import Layer
 from .norm import LayerNorm
 
-__all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
+__all__ = ["MultiHeadAttention", "StaticKVCache", "TransformerEncoderLayer",
            "TransformerEncoder", "TransformerDecoderLayer",
            "TransformerDecoder", "Transformer"]
+
+
+class StaticKVCache(typing.NamedTuple):
+    """Preallocated KV cache for incremental decoding — the TPU redesign of
+    the reference's Cache/StaticCache tuples (reference
+    python/paddle/nn/layer/transformer.py:85 MultiHeadAttention.Cache).
+
+    The reference grows its cache by concat each step, which on XLA means a
+    new shape — and a fresh compilation — per generated token. Here k/v are
+    fixed [b, heads, max_len, head_dim] buffers written in place with
+    lax.dynamic_update_slice at `index` (an i32 scalar = tokens filled), so
+    the decode step keeps ONE static shape: jit once, O(1) work per token,
+    scan-able. Fields are raw jnp arrays (a pytree — usable as a lax.scan
+    carry)."""
+
+    k: object    # [b, h, max_len, head_dim]
+    v: object    # [b, h, max_len, head_dim]
+    index: object  # i32 scalar: number of valid positions
+
+
+def _static_cache_attention(q, kc, vc, index, scale, dropout_p, training):
+    """Attention of q [b,h,s,d] over a partially-filled cache [b,h,L,d]:
+    position p = index + row attends to cache cols <= p (causal within the
+    new chunk, everything before it unconditionally)."""
+    import jax.numpy as jnp
+    s, L = q.shape[2], kc.shape[2]
+    row = index + jnp.arange(s, dtype=jnp.int32)[:, None]      # [s, 1]
+    col = jnp.arange(L, dtype=jnp.int32)[None, :]              # [1, L]
+    live = col <= row                                          # [s, L]
+    scores = jnp.einsum("bhsd,bhld->bhsl", q, kc,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(live[None, None], scores, -1e9)
+    p = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if dropout_p and training:
+        from ...core import rng as _rng
+        keep = 1.0 - dropout_p
+        p = p * jax.random.bernoulli(_rng.next_key(), keep, p.shape) / keep
+    return jnp.einsum("bhsl,bhld->bhsd", p, vc)
 
 
 def _convert_attention_mask(attn_mask, dtype):
@@ -85,6 +127,25 @@ class MultiHeadAttention(Layer):
             q, k, v = self.q_proj(query), self.k_proj(key), self.v_proj(value)
 
         q, k, v = self._split_heads(q), self._split_heads(k), self._split_heads(v)
+        if isinstance(cache, StaticKVCache):
+            import jax.numpy as jnp
+            kj, vj = k._value.astype(cache.k.dtype), \
+                v._value.astype(cache.v.dtype)
+            idx = jnp.asarray(cache.index, jnp.int32)
+            zero = jnp.int32(0)
+            kc = jax.lax.dynamic_update_slice(cache.k, kj,
+                                              (zero, zero, idx, zero))
+            vc = jax.lax.dynamic_update_slice(cache.v, vj,
+                                              (zero, zero, idx, zero))
+            out = _static_cache_attention(
+                q._value, kc, vc, idx, self.head_dim ** -0.5,
+                self.dropout, self.training)
+            from ...core.tensor import Tensor
+            out = ops.transpose(Tensor(out, _internal=True), [0, 2, 1, 3])
+            b, s = out.shape[0], out.shape[1]
+            out = self.out_proj(ops.reshape(out, [b, s, self.embed_dim]))
+            new_cache = StaticKVCache(kc, vc, idx + jnp.int32(kj.shape[2]))
+            return out, new_cache
         if cache is not None:
             k = ops.concat([cache[0], k], axis=2)
             v = ops.concat([cache[1], v], axis=2)
@@ -104,6 +165,15 @@ class MultiHeadAttention(Layer):
         b = key.shape[0]
         k = ops.zeros([b, self.num_heads, 0, self.head_dim], "float32")
         return (k, k)
+
+    def gen_static_cache(self, batch_size, max_len, dtype="float32"):
+        """Preallocated O(1)-per-token decode cache (see StaticKVCache)."""
+        import jax.numpy as jnp
+
+        from ...core.dtype import to_jax_dtype
+        shape = (batch_size, self.num_heads, max_len, self.head_dim)
+        z = jnp.zeros(shape, to_jax_dtype(dtype))
+        return StaticKVCache(z, z, jnp.int32(0))
 
 
 class TransformerEncoderLayer(Layer):

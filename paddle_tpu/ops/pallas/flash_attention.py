@@ -160,6 +160,7 @@ def _fwd(q, k, v, bias, scale, causal, heads, bq, bk):
             _vmem((bq, 1), jnp.float32),
             _vmem((bq, d), jnp.float32),
         ],
+        compiler_params=_cparams("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*args)
     return out, lse[..., 0]
@@ -168,6 +169,15 @@ def _fwd(q, k, v, bias, scale, causal, heads, bq, bk):
 def _vmem(shape, dtype):
     from jax.experimental.pallas import tpu as pltpu
     return pltpu.VMEM(shape, dtype)
+
+
+def _cparams(*semantics):
+    """Mosaic grid semantics: 'parallel' dims can be reordered/pipelined by
+    the compiler, 'arbitrary' marks the sequential reduction dim (the
+    revisiting accumulator pattern). Without this Mosaic assumes every dim
+    is arbitrary and cannot overlap the next block's DMA with compute."""
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.CompilerParams(dimension_semantics=semantics)
 
 
 # --------------------------------------------------------------------------
@@ -303,6 +313,7 @@ def _bwd(q, k, v, bias, out, lse, do, scale, causal, heads, bq, bk):
         out_specs=pl.BlockSpec((1, bq, d), lambda ib, i, j: (ib, i, _Z)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[_vmem((bq, d), jnp.float32)],
+        compiler_params=_cparams("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*args)
 
@@ -347,6 +358,7 @@ def _bwd(q, k, v, bias, out, lse, do, scale, causal, heads, bq, bk):
         ],
         scratch_shapes=[_vmem((bk, d), jnp.float32),
                         _vmem((bk, d), jnp.float32)],
+        compiler_params=_cparams("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*args)
     return dq, dk, dv

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from .flash_attention import NEG_INF, _Z, _interpret, _vmem
+from .flash_attention import NEG_INF, _Z, _cparams, _interpret, _vmem
 
 
 def _pick(n, target):
@@ -107,6 +107,7 @@ def _fwd(h, w, b, y, ignore, bn, bv):
         scratch_shapes=[_vmem((bn, 1), jnp.float32),
                         _vmem((bn, 1), jnp.float32),
                         _vmem((bn, 1), jnp.float32)],
+        compiler_params=_cparams("parallel", "arbitrary"),
         interpret=_interpret(),
     )(*args)
     return loss.reshape(n), lse.reshape(n)
@@ -223,6 +224,7 @@ def _bwd(h, w, b, y, lse, g, ignore, bn, bv):
         out_specs=pl.BlockSpec((1, bn, hd), lambda i, j: (_Z, i, _Z)),
         out_shape=jax.ShapeDtypeStruct((1, n, hd), h.dtype),
         scratch_shapes=[_vmem((bn, hd), jnp.float32)],
+        compiler_params=_cparams("parallel", "arbitrary"),
         interpret=_interpret(),
     )(*base_args).reshape(n, hd)
 
@@ -248,6 +250,7 @@ def _bwd(h, w, b, y, lse, g, ignore, bn, bv):
                    jax.ShapeDtypeStruct((1, vocab), jnp.float32)],
         scratch_shapes=[_vmem((bv, hd), jnp.float32),
                         _vmem((1, bv), jnp.float32)],
+        compiler_params=_cparams("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*base_args)
     dw = dw.reshape(vocab, hd)

@@ -4,7 +4,11 @@ blocks with causal masking via the fused attention core.
 """
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
 
 from ... import nn, ops
 from ...nn import functional as F
@@ -37,14 +41,21 @@ class GPTBlock(nn.Layer):
         self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
         self.drop = nn.Dropout(cfg.dropout)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         h = self.ln1(x)
-        # is_causal (not a materialized [s,s] mask) keeps the Pallas flash
-        # kernel's in-kernel triangular masking + block skipping eligible
-        x = x + self.attn(h, is_causal=True)
+        if cache is not None:
+            # StaticKVCache path: positions are tracked by the cache index,
+            # masking happens against the cache — no is_causal needed
+            a, cache = self.attn(h, cache=cache)
+            x = x + a
+        else:
+            # is_causal (not a materialized [s,s] mask) keeps the Pallas
+            # flash kernel's in-kernel triangular masking + block skipping
+            # eligible
+            x = x + self.attn(h, is_causal=True)
         h = self.ln2(x)
         x = x + self.drop(self.fc2(F.gelu(self.fc1(h))))
-        return x
+        return x if cache is None else (x, cache)
 
 
 class GPT(nn.Layer):
@@ -76,15 +87,53 @@ class GPT(nn.Layer):
         # weight-tied LM head
         return ops.matmul(x, self.wte.weight, transpose_y=True)
 
+    def _forward_cached(self, input_ids, caches, index):
+        """One cached decode/prefill pass. input_ids [b, s_new] (Tensor or
+        jnp), caches: list of StaticKVCache (one per block), index: i32
+        tokens already in the cache. Returns (last-position logits [b, V]
+        jnp, new caches)."""
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(input_ids, _internal=True)
+        s = ids.shape[1]
+        pos = index + jnp.arange(s, dtype=jnp.int32)
+        x = self.wte(ids) + self.wpe(Tensor(pos, _internal=True))
+        x = self.drop(x)
+        new_caches = []
+        for blk, c in zip(self.blocks, caches):
+            x, c = blk(x, cache=c)
+            new_caches.append(c)
+        x = self.ln_f(x)
+        logits = ops.matmul(x[:, -1], self.wte.weight, transpose_y=True)
+        return logits._value, new_caches
+
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=None, eos_token_id=None):
+                 top_k=None, eos_token_id=None, use_cache=True, seed=0):
         """Autoregressive sampling (reference generation utils; greedy at
-        temperature=0). Eager host loop re-forwarding the growing prefix —
-        the simple inference form; the flash kernel keeps each forward
-        O(s) in memory. Returns [b, s + new] ids."""
+        temperature=0). Returns [b, s + new] ids.
+
+        use_cache=True (default): static-shape KV-cache decode — the whole
+        generation (prefill + lax.scan over steps) is ONE jitted dispatch,
+        O(1) work per token and no per-token retrace; re-traced only per
+        (prompt_len, max_new_tokens, sampling-config). The reference's
+        incremental decoding lives in its C++ predictor
+        (inference/api/analysis_predictor.cc:306); here it is a compiled
+        scan over a preallocated cache (nn/layer/transformer.py
+        StaticKVCache). use_cache=False keeps the simple host loop that
+        re-forwards the growing prefix (the equality oracle in tests)."""
         import numpy as np
 
         from ...core import tape as _tape
+
+        if use_cache:
+            with _tape.no_grad():
+                return self._generate_cached(
+                    input_ids, int(max_new_tokens), float(temperature),
+                    None if top_k is None else int(top_k),
+                    eos_token_id, int(seed))
 
         with _tape.no_grad():
             ids = input_ids
@@ -115,3 +164,86 @@ class GPT(nn.Layer):
                 if eos_token_id is not None and finished.all():
                     break
             return ids
+
+    def _generate_cached(self, input_ids, max_new, temperature, top_k,
+                         eos_id, seed):
+        import numpy as np
+
+        from ... import to_tensor
+        from ...core.tensor import Tensor
+
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else to_tensor(input_ids)
+        b, s = int(ids.shape[0]), int(ids.shape[1])
+        total = s + max_new
+        if total > self.config.max_seq_len:
+            raise ValueError(
+                f"generate: prompt {s} + max_new_tokens {max_new} exceeds "
+                f"max_seq_len {self.config.max_seq_len}")
+
+        params, buffers = self.functional_state()
+        cache_dtype = jnp.bfloat16 if any(
+            v.dtype == jnp.bfloat16 for v in params.values()) else jnp.float32
+
+        fn = _decode_fn(self, max_new, temperature, top_k,
+                        None if eos_id is None else int(eos_id),
+                        total, jnp.dtype(cache_dtype).name, b, s)
+        try:
+            toks = fn(params, buffers, ids._value,
+                      jax.random.PRNGKey(seed))
+        finally:
+            # tracing mutated the layers' parameters to tracers; restore
+            # the real arrays so eager use of the net keeps working
+            self.load_functional_state(params, buffers)
+        out = np.concatenate([np.asarray(ids._value, np.int64),
+                              np.asarray(toks, np.int64)], axis=1)
+        return to_tensor(out)
+
+
+@functools.lru_cache(maxsize=64)
+def _decode_fn(net, max_new, temperature, top_k, eos_id, total, cache_dtype,
+               b, s):
+    """Build + jit the whole-generation program (prefill + lax.scan decode):
+    ONE compiled dispatch per generate() call, O(1) work per token. Cached
+    per (model identity, step budget, sampling config, shapes) so repeat
+    calls skip retracing."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...core import tape as _tape
+
+    dt = jnp.dtype(cache_dtype)
+
+    def run(params, buffers, ids_j, key):
+        with _tape.no_grad():
+            net.load_functional_state(params, buffers)
+            caches = [blk.attn.gen_static_cache(b, total, dt)
+                      for blk in net.blocks]
+            logits, caches = net._forward_cached(ids_j, caches, jnp.int32(0))
+
+            def sample(logits, k):
+                if temperature == 0:
+                    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                lg = (logits / temperature).astype(jnp.float32)
+                if top_k is not None:
+                    kth = jax.lax.top_k(lg, top_k)[0][:, -1:]
+                    lg = jnp.where(lg < kth, -1e9, lg)
+                return jax.random.categorical(k, lg, axis=-1).astype(
+                    jnp.int32)
+
+            def body(carry, step_key):
+                caches, logits, finished, index = carry
+                nxt = sample(logits, step_key)
+                if eos_id is not None:
+                    nxt = jnp.where(finished, jnp.int32(eos_id), nxt)
+                    finished = finished | (nxt == eos_id)
+                logits, caches = net._forward_cached(nxt[:, None], caches,
+                                                     index)
+                return (caches, logits, finished, index + 1), nxt
+
+            init = (caches, logits, jnp.zeros((b,), bool), jnp.int32(s))
+            keys = jax.random.split(key, max_new)
+            _, toks = jax.lax.scan(body, init, keys)       # [max_new, b]
+        return toks.swapaxes(0, 1)                         # [b, max_new]
+
+    return jax.jit(run)
