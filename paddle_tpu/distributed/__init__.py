@@ -12,7 +12,7 @@ import importlib as _importlib
 
 _LAZY_MODULES = ("fleet", "sharding", "pipeline", "launch", "spawn", "moe",
                  "collective", "parallel", "ring_attention", "bootstrap",
-                 "elastic", "ps")
+                 "elastic", "ps", "localsgd")
 _LAZY_NAMES = {
     "recompute": "recompute", "checkpoint_policy": "recompute",
     "all_gather": "collective", "all_reduce": "collective",

@@ -100,10 +100,38 @@ def init(role_maker=None, is_collective=True, strategy=None):
 
 class HybridCommunicateGroup:
     """Topology info (reference fleet/base/topology.py
-    HybridCommunicateGroup)."""
+    HybridCommunicateGroup). Ranks are REAL mesh coordinates: this
+    process's position along each axis, found by locating one of its
+    devices in the active mesh (multi-process SPMD), falling back to a
+    row-major decomposition of the process rank over the axis sizes."""
 
     def __init__(self, shape):
         self.shape = dict(shape)
+
+    def _coords(self):
+        axes = list(self.shape.keys())
+        from .. import mesh as mesh_mod
+        mesh = mesh_mod.get_mesh()
+        try:
+            import jax
+            pid = jax.process_index()
+            if mesh is not None and set(axes) <= set(mesh.axis_names):
+                import numpy as np
+                dev = mesh.devices
+                for idx in np.ndindex(dev.shape):
+                    if dev[idx].process_index == pid:
+                        return dict(zip(mesh.axis_names, idx))
+        except Exception:
+            pass
+        r = get_rank()
+        coords = {}
+        for ax in reversed(axes):           # row-major, last axis fastest
+            coords[ax] = r % self.shape[ax]
+            r //= self.shape[ax]
+        return coords
+
+    def _rank(self, axis):
+        return int(self._coords().get(axis, 0))
 
     def get_data_parallel_world_size(self):
         return self.shape.get("dp", 1)
@@ -121,10 +149,19 @@ class HybridCommunicateGroup:
         return self.shape.get("ep", 1)
 
     def get_data_parallel_rank(self):
-        return 0
+        return self._rank("dp")
 
     def get_model_parallel_rank(self):
-        return 0
+        return self._rank("tp")
+
+    def get_stage_id(self):
+        return self._rank("pp")
+
+    def get_sep_parallel_rank(self):
+        return self._rank("sp")
+
+    def get_expert_parallel_rank(self):
+        return self._rank("ep")
 
 
 def get_hybrid_communicate_group():
@@ -218,10 +255,16 @@ def stop_worker():
     if client is not None:
         try:
             client.barrier(_STOP_BARRIER, worker_index())
-        except RuntimeError:
-            pass  # pre-ps-stack server config without the barrier table
+        except (RuntimeError, ConnectionError, OSError):
+            # pre-ps-stack server config without the barrier table, or
+            # servers already gone/unreachable — teardown must still
+            # proceed to close() so the worker exits cleanly
+            pass
         if is_first_worker():
-            client.stop_servers()
+            try:
+                client.stop_servers()
+            except (ConnectionError, OSError):
+                pass  # servers already dead is a successful stop
         client.close()
 
 

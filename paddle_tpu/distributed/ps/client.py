@@ -125,21 +125,43 @@ class Communicator:
     the push). flush() drains synchronously; used at barriers/epoch ends.
     """
 
-    def __init__(self, client: PSClient, send_every=4, max_queue=64):
+    def __init__(self, client: PSClient, send_every=4, max_queue=64,
+                 max_delay_s=0.05):
         self._client = client
         self._send_every = int(send_every)
+        self._max_delay_s = float(max_delay_s)
         self._q: queue.Queue = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
+        self._error: BaseException | None = None
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     # ------------------------------------------------------------- worker
+    def _check_alive(self):
+        """Surface a background send failure to the caller instead of the
+        r03 failure mode: thread dies silently, queue fills, push_* blocks
+        forever in Queue.put."""
+        if self._error is not None:
+            raise RuntimeError(
+                "ps communicator send thread died") from self._error
+        if not self._thread.is_alive() and not self._stop.is_set():
+            raise RuntimeError("ps communicator send thread is not running")
+
+    def _put(self, item):
+        self._check_alive()
+        while True:
+            try:
+                self._q.put(item, timeout=1.0)
+                return
+            except queue.Full:
+                self._check_alive()   # don't hang on a dead consumer
+
     def push_sparse(self, table, ids, grads):
-        self._q.put(("sparse", table, np.asarray(ids, np.int64).reshape(-1),
-                     np.asarray(grads, np.float32)))
+        self._put(("sparse", table, np.asarray(ids, np.int64).reshape(-1),
+                   np.asarray(grads, np.float32)))
 
     def push_dense(self, table, grad):
-        self._q.put(("dense", table, None, np.asarray(grad, np.float32)))
+        self._put(("dense", table, None, np.asarray(grad, np.float32)))
 
     # --------------------------------------------------------- background
     def _loop(self):
@@ -148,19 +170,42 @@ class Communicator:
         # wait can't slip past a produced-but-unsent item (an Event toggled
         # on a momentary empty poll could)
         pending = []
-        while not self._stop.is_set() or not self._q.empty() or pending:
-            try:
-                pending.append(self._q.get(timeout=0.05))
-            except queue.Empty:
-                pass
-            if pending and (len(pending) >= self._send_every
-                            or self._stop.is_set() or self._q.empty()):
+        first_ts = None
+        try:
+            while not self._stop.is_set() or not self._q.empty() or pending:
                 try:
-                    self._send_merged(pending)
-                finally:
-                    for _ in pending:
-                        self._q.task_done()
-                pending = []
+                    pending.append(self._q.get(timeout=0.05))
+                    if first_ts is None:
+                        first_ts = time.monotonic()
+                except queue.Empty:
+                    pass
+                # batch trigger: enough items for a merge, a stop/drain, or
+                # the oldest item aging past max_delay — NOT momentary
+                # queue emptiness, which under normal pacing fires every
+                # iteration and defeats send_every/MergeAdd batching
+                aged = (first_ts is not None
+                        and time.monotonic() - first_ts >= self._max_delay_s)
+                if pending and (len(pending) >= self._send_every
+                                or self._stop.is_set() or aged):
+                    try:
+                        self._send_merged(pending)
+                    finally:
+                        for _ in pending:
+                            self._q.task_done()
+                    pending = []
+                    first_ts = None
+        except BaseException as e:  # noqa: BLE001 — re-raised to callers
+            self._error = e
+            # account for anything we'll never send so flush() raises
+            # instead of timing out
+            for _ in pending:
+                self._q.task_done()
+            while True:
+                try:
+                    self._q.get_nowait()
+                    self._q.task_done()
+                except queue.Empty:
+                    break
 
     def _send_merged(self, items):
         sparse: dict[str, list] = {}
@@ -189,11 +234,17 @@ class Communicator:
         deadline = time.monotonic() + timeout
         with self._q.all_tasks_done:
             while self._q.unfinished_tasks:
+                if self._error is not None:
+                    break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError("communicator failed to drain")
-                self._q.all_tasks_done.wait(remaining)
+                self._q.all_tasks_done.wait(min(remaining, 0.5))
+        self._check_alive()
 
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=60.0)
+        if self._error is not None:
+            raise RuntimeError(
+                "ps communicator send thread died") from self._error

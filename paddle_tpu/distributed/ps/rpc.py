@@ -8,10 +8,23 @@ the transport is a length-prefixed binary frame over TCP — numpy payloads
 ride as raw buffers (zero-copy out of the socket), metadata as a small
 pickled header. One thread per live connection on the server; clients
 hold one persistent connection per server and serialize calls on it.
+
+Security: deserialization uses a RESTRICTED unpickler that only resolves
+numpy array/dtype reconstructors and plain containers — an arbitrary
+`__reduce__` gadget from a hostile peer raises UnpicklingError instead of
+executing (the reference's protobuf transport has no gadget surface; this
+restores that property). Defense in depth: set PADDLE_PS_TOKEN in the job
+environment and every connection must open with a matching token
+handshake before any request is served. PS endpoints are still cluster
+infrastructure — bind them to loopback or a trusted network, never the
+open internet.
 """
 from __future__ import annotations
 
+import hmac
+import importlib
 import io
+import os
 import pickle
 import socket
 import struct
@@ -22,6 +35,39 @@ import numpy as np
 __all__ = ["send_msg", "recv_msg", "Connection", "serve"]
 
 _HDR = struct.Struct("!Q")
+
+# modules:names the restricted unpickler will resolve — numpy array/dtype
+# reconstruction plus the stdlib pieces numpy's reducers reference
+_SAFE_GLOBALS = {
+    "builtins": {"complex", "slice", "range", "frozenset", "set",
+                 "bytearray"},
+    "numpy": {"ndarray", "dtype", "matrix", "generic", "bool_", "number",
+              "int8", "int16", "int32", "int64", "uint8", "uint16",
+              "uint32", "uint64", "float16", "float32", "float64",
+              "complex64", "complex128", "longlong", "ulonglong", "intc",
+              "uintc", "frombuffer"},
+    "numpy.core.multiarray": {"_reconstruct", "scalar"},
+    "numpy._core.multiarray": {"_reconstruct", "scalar"},
+    "numpy.core.numeric": {"_frombuffer"},
+    "numpy._core.numeric": {"_frombuffer"},
+    "numpy.dtypes": None,   # dtype singletons (Float32DType, ...)
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if module in _SAFE_GLOBALS and (
+                _SAFE_GLOBALS[module] is None
+                or name in _SAFE_GLOBALS[module]):
+            return getattr(importlib.import_module(module), name)
+        raise pickle.UnpicklingError(
+            f"ps rpc: refusing to unpickle global {module}.{name} "
+            "(only numpy payloads are allowed on this transport)")
+
+
+def _loads(data, buffers=None):
+    return _RestrictedUnpickler(io.BytesIO(data),
+                                buffers=buffers or []).load()
 
 
 def _pack(obj) -> bytes:
@@ -38,13 +84,17 @@ def _pack(obj) -> bytes:
 
 def _unpack(data: bytes):
     n = _HDR.unpack_from(data)[0]
-    sizes = pickle.loads(data[_HDR.size:_HDR.size + n])
+    sizes = _loads(data[_HDR.size:_HDR.size + n])
+    if not isinstance(sizes, list) \
+            or not all(isinstance(s, int) and 0 <= s <= len(data)
+                       for s in sizes):
+        raise pickle.UnpicklingError("ps rpc: malformed frame header")
     off = _HDR.size + n
     parts = []
     for s in sizes:
         parts.append(data[off:off + s])
         off += s
-    return pickle.loads(parts[0], buffers=parts[1:])
+    return _loads(parts[0], buffers=parts[1:])
 
 
 def send_msg(sock: socket.socket, obj) -> None:
@@ -96,6 +146,14 @@ class Connection:
                 time.sleep(0.2)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()
+        token = os.environ.get("PADDLE_PS_TOKEN")
+        if token:
+            send_msg(self._sock, {"method": "__auth__", "token": token})
+            reply = recv_msg(self._sock)
+            if not reply or reply.get("error"):
+                raise ConnectionError(
+                    "ps auth handshake rejected: "
+                    f"{(reply or {}).get('error', 'closed')}")
 
     def call(self, method: str, **kwargs):
         with self._lock:
@@ -127,14 +185,29 @@ def serve(endpoint: str, handler, stop_event: threading.Event):
     srv.settimeout(0.2)
     bound = srv.getsockname()[1]
 
+    token = os.environ.get("PADDLE_PS_TOKEN")
+
     def _conn_loop(conn):
         conn.settimeout(None)
+        authed = not token
         try:
             while not stop_event.is_set():
                 req = recv_msg(conn)
                 if req is None:
                     break
                 method = req.pop("method")
+                if not authed:
+                    # first frame must be the token handshake
+                    if method == "__auth__" and hmac.compare_digest(
+                            str(req.get("token", "")), token):
+                        authed = True
+                        send_msg(conn, {"result": "ok"})
+                        continue
+                    send_msg(conn, {"error": "auth required"})
+                    break
+                if method == "__auth__":
+                    send_msg(conn, {"result": "ok"})
+                    continue
                 try:
                     result = handler(method, req)
                     send_msg(conn, {"result": result})

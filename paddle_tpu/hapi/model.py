@@ -70,6 +70,7 @@ class _CompiledEngine:
         self._accum_grads = None
         self._accum_count = 0
         self._param_names = None
+        self._localsgd = None         # replicated-state LocalSGD mode
 
     # ---- functional pieces -------------------------------------------------
     def _amp_ctx(self):
@@ -122,7 +123,9 @@ class _CompiledEngine:
         return {"mesh": mesh, "param": param_sh, "repl": repl,
                 "batch": batch}
 
-    def _build_train_fn(self):
+    def _make_train_step(self):
+        """The pure fwd+bwd+update step, shared by the jit/GSPMD path
+        (_build_train_fn) and the LocalSGD shard_map path."""
         model = self.model
         opt = model._optimizer
         net = model.network
@@ -173,6 +176,12 @@ class _CompiledEngine:
                 new_params.update(new_train)
             return lval, outs, new_bufs, new_params, new_slots, scale_state
 
+        return step
+
+    def _build_train_fn(self):
+        step = self._make_train_step()
+        amp_cfg = self.model._amp_configs
+        scaler = amp_cfg.get("scaler") if amp_cfg else None
         plan = self._sharding_plan()
         if plan is None:
             return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -191,6 +200,141 @@ class _CompiledEngine:
                           plan["repl"], plan["repl"], plan["batch"],
                           plan["batch"], scale_sh),
             donate_argnums=(0, 1, 2))
+
+    # ---- LocalSGD (strategy.localsgd / adaptive_localsgd) ------------------
+    def _localsgd_cfg(self):
+        """Live strategy.localsgd knob (reference
+        meta_optimizers/localsgd_optimizer.py LocalSGDOptimizer /
+        AdaptiveLocalSGDOptimizer): requires a mesh with dp>=2. Returns
+        None when the plain path applies."""
+        strat = getattr(self.model._optimizer, "_dist_strategy", None)
+        if strat is None or not (getattr(strat, "localsgd", False)
+                                 or getattr(strat, "adaptive_localsgd",
+                                            False)):
+            return None
+        from ..distributed import mesh as mesh_mod
+        mesh = mesh_mod.get_mesh()
+        if mesh is None or "dp" not in mesh.axis_names \
+                or mesh.shape["dp"] < 2:
+            return None
+        if self.model._amp_configs and \
+                self.model._amp_configs.get("scaler"):
+            raise ValueError(
+                "strategy.localsgd does not compose with dynamic loss "
+                "scaling (the reference's LocalSGDOptimizer is likewise "
+                "incompatible with AMP program rewriting); use bf16 O2")
+        cfg = dict(getattr(strat, "localsgd_configs", {}) or {})
+        return {"mesh": mesh, "k": max(1, int(cfg.get("k_steps", 4) or 4)),
+                "adaptive": bool(getattr(strat, "adaptive_localsgd", False)),
+                "max_k": int(cfg.get("max_k_steps", 16) or 16),
+                "rel_tol": float(cfg.get("rel_tol", 0.01) or 0.01)}
+
+    def _build_localsgd_fn(self, k, mesh):
+        """shard_map step over dp: each dp shard owns a PRIVATE copy of
+        params/slots (leading replica dim), steps locally, and parameters
+        are pmean-averaged only every k-th step — one lax.cond'ed ICI
+        collective instead of a per-step gradient all-reduce
+        (distributed/localsgd.py carries the standalone form)."""
+        from jax.sharding import PartitionSpec as P
+        step = self._make_train_step()
+
+        def spmd(params, buffers, slots, lr, t, key, inputs, labels,
+                 counter):
+            one = lambda q: jax.tree_util.tree_map(lambda x: x[0], q)  # noqa: E731
+            lift = lambda q: jax.tree_util.tree_map(lambda x: x[None], q)  # noqa: E731
+            key = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+            lval, outs, new_bufs, new_p, new_s, _ = step(
+                one(params), buffers, one(slots), lr, t, key,
+                inputs, labels, {})
+            c = counter[0] + 1
+
+            def sync(q):
+                return jax.tree_util.tree_map(
+                    lambda x: jax.lax.pmean(x, "dp"), q)
+
+            new_p = jax.lax.cond(c % k == 0, sync, lambda q: q, new_p)
+            # buffers (e.g. BN running stats) stay replicated: average
+            new_bufs = sync(new_bufs)
+            lval = jax.lax.pmean(lval, "dp")
+            return lval, outs, new_bufs, lift(new_p), lift(new_s), c[None]
+
+        st = self._localsgd
+        pspec = jax.tree_util.tree_map(lambda _: P("dp"), st["params"])
+        sspec = jax.tree_util.tree_map(lambda _: P("dp"), st["slots"])
+        bspec = jax.tree_util.tree_map(
+            lambda _: P(), {n: 0 for n, _ in
+                            self.model.network.named_buffers()})
+        return jax.jit(jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(pspec, bspec, sspec, P(), P(), P(), P("dp"),
+                      P("dp"), P("dp")),
+            out_specs=(P(), P("dp"), bspec, pspec, sspec, P("dp")),
+            check_vma=False))
+
+    def _train_batch_localsgd(self, cfg, raw_in, raw_lab):
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        model = self.model
+        net = model.network
+        opt = model._optimizer
+        mesh = cfg["mesh"]
+        if self._localsgd is None:
+            params, buffers = net.functional_state()
+            named = dict(net.named_parameters())
+            opt._ensure_slots({n: v for n, v in params.items()
+                               if not named[n].stop_gradient})
+            slots = {n: opt._slots[n] for n in opt._slots
+                     if n in params and not named[n].stop_gradient}
+            n = mesh.shape["dp"]
+            sh = NamedSharding(mesh, P("dp"))
+            rep = lambda q: jax.tree_util.tree_map(  # noqa: E731
+                lambda x: jax.device_put(
+                    jnp.broadcast_to(x[None], (n,) + x.shape), sh), q)
+            self._localsgd = {
+                "params": rep(params), "slots": rep(slots),
+                "counter": jax.device_put(jnp.zeros((n,), jnp.int32), sh),
+                "k": cfg["k"], "fns": {}, "last_sync_loss": None}
+        st = self._localsgd
+        k = st["k"]
+        if k not in st["fns"]:
+            st["fns"][k] = self._build_localsgd_fn(k, mesh)
+        opt._step_count += 1
+        params, buffers = net.functional_state()
+        lval, outs, new_bufs, st["params"], st["slots"], st["counter"] = \
+            st["fns"][k](st["params"], buffers, st["slots"],
+                         jnp.asarray(opt.get_lr(), jnp.float32),
+                         jnp.asarray(opt._step_count, jnp.int32),
+                         _rng.next_key(), raw_in, raw_lab, st["counter"])
+        self._write_back({}, new_bufs)
+        c = int(np.asarray(st["counter"])[0])
+        if cfg["adaptive"] and c % k == 0:
+            loss = float(np.asarray(lval))
+            last = st["last_sync_loss"]
+            if last is not None and loss > last * (1 - cfg["rel_tol"]):
+                st["k"] = min(k + 1, cfg["max_k"])
+            st["last_sync_loss"] = loss
+        if c % k == 0:
+            # synced boundary: the replicas agree — surface the averaged
+            # params to the net so eval/save/callbacks see fresh weights
+            self._write_back(jax.tree_util.tree_map(
+                lambda x: x[0], st["params"]), {})
+        return lval, outs
+
+    def finalize_localsgd(self):
+        """Final cross-replica average written back into the network;
+        called at fit() end and before eval/predict/save."""
+        st = self._localsgd
+        if st is None:
+            return
+        avg = jax.tree_util.tree_map(
+            lambda x: jnp.mean(x.astype(jnp.float32), axis=0).astype(
+                x.dtype), st["params"])
+        self._write_back(avg, {})
+        slot_avg = jax.tree_util.tree_map(
+            lambda x: jnp.mean(x.astype(jnp.float32), axis=0).astype(
+                x.dtype), st["slots"])
+        self.model._optimizer._slots.update(slot_avg)
+        self._localsgd = None
 
     def _build_grad_fn(self):
         """Forward+backward only — used for gradient accumulation
@@ -288,6 +432,10 @@ class _CompiledEngine:
         raw_lab = tuple(_to_raw(v) for v in labels)
         accumulating = (not update) or self._accum_grads is not None
 
+        lcfg = self._localsgd_cfg()
+        if lcfg is not None and not accumulating:
+            return self._train_batch_localsgd(lcfg, raw_in, raw_lab)
+
         if not accumulating:
             # fast path: forward+backward+update fused in one XLA program
             if self._train_fn is None:
@@ -358,6 +506,7 @@ class _CompiledEngine:
         return lval, outs
 
     def eval_batch(self, inputs, labels):
+        self.finalize_localsgd()
         net = self.model.network
         net.eval()
         params, buffers = net.functional_state()
@@ -371,6 +520,7 @@ class _CompiledEngine:
         return lval, outs
 
     def predict_batch(self, inputs):
+        self.finalize_localsgd()
         net = self.model.network
         net.eval()
         params, buffers = net.functional_state()
@@ -614,6 +764,7 @@ class Model:
                     break
         if acp is not None:
             acp.wait()
+        self._engine.finalize_localsgd()
         cbks.on_end("train", logs)
         return self
 
@@ -749,6 +900,7 @@ class Model:
 
     def save(self, path, training=True):
         """path prefix: writes {path}.pdparams (+ {path}.pdopt if training)."""
+        self._engine.finalize_localsgd()
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
