@@ -16,9 +16,11 @@ from ...ops import (  # noqa: F401 — re-exported op families
     hardsigmoid, hardswish, hardtanh, hardshrink, softshrink, tanhshrink,
     silu, swish, mish, softplus, softsign, softmax, log_softmax, log_sigmoid,
     gumbel_softmax, maxout, thresholded_relu, glu, normalize, tanh,
-    conv1d, conv2d, conv3d, conv2d_transpose,
-    max_pool1d, max_pool2d, avg_pool2d, adaptive_avg_pool2d,
-    adaptive_max_pool2d, interpolate, pixel_shuffle, unfold, pad,
+    conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+    conv3d_transpose,
+    max_pool1d, max_pool2d, max_pool3d, avg_pool2d, avg_pool3d,
+    adaptive_avg_pool2d, adaptive_max_pool2d, adaptive_avg_pool3d,
+    adaptive_max_pool3d, interpolate, pixel_shuffle, unfold, pad,
     layer_norm, instance_norm, group_norm, rms_norm, local_response_norm,
     dropout, one_hot, embedding as _embedding_op,
     cross_entropy, softmax_with_cross_entropy, nll_loss, mse_loss, l1_loss,

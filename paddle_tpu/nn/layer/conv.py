@@ -7,7 +7,8 @@ from .. import functional as F
 from .. import initializer as I
 from .layers import Layer
 
-__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose", "Conv1DTranspose"]
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose",
+           "Conv1DTranspose", "Conv3DTranspose"]
 
 
 class _ConvNd(Layer):
@@ -98,6 +99,41 @@ class Conv2DTranspose(_ConvNd):
                                   data_format=self._data_format)
 
 
-class Conv1DTranspose(Layer):
-    def __init__(self, *a, **k):
-        raise NotImplementedError("Conv1DTranspose: planned")
+class Conv1DTranspose(_ConvNd):
+    """reference operators/conv_transpose_op.cc (1-D); weight IOK."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True)
+        self._output_padding = output_padding
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias,
+                                  stride=self._stride, padding=self._padding,
+                                  output_padding=self._output_padding,
+                                  dilation=self._dilation,
+                                  groups=self._groups,
+                                  data_format=self._data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    """reference operators/conv_transpose_op.cc (3-D); weight IODHW."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True)
+        self._output_padding = output_padding
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias,
+                                  stride=self._stride, padding=self._padding,
+                                  output_padding=self._output_padding,
+                                  dilation=self._dilation,
+                                  groups=self._groups,
+                                  data_format=self._data_format)

@@ -192,5 +192,55 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, *a, **k):
-        raise NotImplementedError("SpectralNorm: planned (power iteration)")
+    """Spectral normalization: weight / sigma_max(weight), sigma estimated
+    by power iteration (reference operators/spectral_norm_op.cc /
+    python/paddle/fluid/layers/nn.py spectral_norm). u/v are persistent
+    buffers updated each forward (stop-gradient, like the reference's
+    in-place power iteration); sigma = u^T W v differentiates through W.
+    The whole iteration is a static Python loop over tiny matvecs — XLA
+    fuses it into the surrounding graph."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        import numpy as np
+
+        from ...core.tensor import to_tensor
+        self._dim = int(dim)
+        self._power_iters = int(power_iters)
+        self._eps = float(eps)
+        self._shape = tuple(int(s) for s in weight_shape)
+        h = self._shape[self._dim]
+        w = int(np.prod(self._shape)) // h
+        rng = np.random.RandomState(0)
+        self.register_buffer("weight_u", to_tensor(
+            rng.normal(size=h).astype(dtype)))
+        self.register_buffer("weight_v", to_tensor(
+            rng.normal(size=w).astype(dtype)))
+
+    def forward(self, weight):
+        import jax
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+        wv = weight._value if isinstance(weight, Tensor) \
+            else jnp.asarray(weight)
+        perm = (self._dim,) + tuple(i for i in range(len(self._shape))
+                                    if i != self._dim)
+        h = self._shape[self._dim]
+        mat = jnp.transpose(wv, perm).reshape(h, -1)     # [h, w]
+        u = self.weight_u._value.astype(mat.dtype)
+        v = self.weight_v._value.astype(mat.dtype)
+
+        def _norm(x):
+            return x / (jnp.linalg.norm(x) + self._eps)
+
+        for _ in range(self._power_iters):
+            v = _norm(mat.T @ u)
+            u = _norm(mat @ v)
+        u = jax.lax.stop_gradient(u)
+        v = jax.lax.stop_gradient(v)
+        self.weight_u.set_value(u.astype(self.weight_u._value.dtype))
+        self.weight_v.set_value(v.astype(self.weight_v._value.dtype))
+        sigma = u @ (mat @ v)
+        return Tensor(wv / sigma, _internal=True)

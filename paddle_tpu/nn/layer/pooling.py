@@ -65,13 +65,32 @@ class AvgPool1D(Layer):
 
 
 class AvgPool3D(Layer):
-    def __init__(self, *a, **k):
-        raise NotImplementedError("AvgPool3D: planned")
+    """reference operators/pool_op.cc pool3d (avg); NCDHW."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self.args = dict(kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive, data_format=data_format)
+
+    def forward(self, x):
+        return F.avg_pool3d(x, **self.args)
 
 
 class MaxPool3D(Layer):
-    def __init__(self, *a, **k):
-        raise NotImplementedError("MaxPool3D: planned")
+    """reference operators/pool_op.cc pool3d (max); NCDHW."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NCDHW", name=None):
+        super().__init__()
+        self.args = dict(kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode,
+                         data_format=data_format)
+
+    def forward(self, x):
+        return F.max_pool3d(x, **self.args)
 
 
 class AdaptiveAvgPool2D(Layer):

@@ -123,8 +123,37 @@ def count_nonzero(x, axis=None, keepdim=False):
                    keepdims=keepdim)
 
 
+@defop
 def mode(x, axis=-1, keepdim=False):
-    raise NotImplementedError("mode: planned")
+    """Most frequent value along `axis` (reference operators/mode_op —
+    unreleased in ~2.0-rc but part of the 2.x surface; torch-compatible
+    semantics: ties resolve to the smallest value). Returns (values,
+    indices) with indices pointing into the input along `axis`.
+
+    Fully vectorized for XLA: sort, mark run starts, recover each
+    position's run length as index - cummax(start_index), take the run
+    with the largest length. No data-dependent control flow."""
+    ax = axis % x.ndim
+    xm = jnp.moveaxis(x, ax, -1)
+    n = xm.shape[-1]
+    sort_idx = jnp.argsort(xm, axis=-1, stable=True)
+    xs = jnp.take_along_axis(xm, sort_idx, axis=-1)
+    idxs = jnp.arange(n, dtype=jnp.int32)
+    is_start = jnp.concatenate(
+        [jnp.ones(xs.shape[:-1] + (1,), bool), xs[..., 1:] != xs[..., :-1]],
+        axis=-1)
+    start = jax.lax.cummax(jnp.where(is_start, idxs, jnp.int32(0)), axis=xs.ndim - 1)
+    runlen = idxs - start + 1
+    best = jnp.argmax(runlen, axis=-1)          # run end of earliest max run
+    values = jnp.take_along_axis(xs, best[..., None], axis=-1)
+    indices = jnp.take_along_axis(sort_idx, best[..., None], axis=-1)
+    if keepdim:
+        values = jnp.moveaxis(values, -1, ax)
+        indices = jnp.moveaxis(indices, -1, ax)
+    else:
+        values = values[..., 0]
+        indices = indices[..., 0]
+    return values, indices
 
 
 @defop

@@ -1,0 +1,167 @@
+"""Round-4 op-library widening (VERDICT r03 item 4): the named stubs —
+mode, 3-D pooling, Conv1D/3DTranspose, SpectralNorm — with the op_test
+numeric-grad treatment. References: operators/mode_op, pool_op.cc (pool3d),
+conv_transpose_op.cc, spectral_norm_op.cc."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops
+from paddle_tpu.nn import functional as F
+
+from op_test import check_grad
+
+
+# ---------------------------------------------------------------- mode ----
+
+def test_mode_matches_torch():
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, 5, (3, 17)).astype("float32")
+    v, i = ops.mode(paddle.to_tensor(x))
+    tv, _ = torch.mode(torch.tensor(x), dim=-1)
+    np.testing.assert_array_equal(v.numpy(), tv.numpy())
+    # returned index points at an occurrence of the mode
+    picked = np.take_along_axis(x, i.numpy()[:, None].astype(int), 1)[:, 0]
+    np.testing.assert_array_equal(picked, v.numpy())
+
+
+def test_mode_axis_keepdim():
+    rng = np.random.RandomState(1)
+    x = rng.randint(0, 3, (4, 6, 5)).astype("int64")
+    v, i = ops.mode(paddle.to_tensor(x), axis=1, keepdim=True)
+    assert v.shape == (4, 1, 5) and i.shape == (4, 1, 5)
+    tv, _ = torch.mode(torch.tensor(x), dim=1, keepdim=True)
+    np.testing.assert_array_equal(v.numpy(), tv.numpy())
+
+
+# ---------------------------------------------------------- 3-D pooling ----
+
+@pytest.mark.parametrize("k,s,p", [(2, 2, 0), (3, 2, 1), ((2, 3, 2), 1, 0)])
+def test_max_pool3d_matches_torch(k, s, p):
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 3, 8, 9, 8).astype("float32")
+    out = F.max_pool3d(paddle.to_tensor(x), k, stride=s, padding=p)
+    ref = tF.max_pool3d(torch.tensor(x), k, stride=s, padding=p)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+
+@pytest.mark.parametrize("k,s,p", [(2, 2, 0), (3, 2, 1)])
+def test_avg_pool3d_matches_torch(k, s, p):
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 8, 8, 8).astype("float32")
+    out = F.avg_pool3d(paddle.to_tensor(x), k, stride=s, padding=p)
+    # paddle exclusive=True == torch count_include_pad=False
+    ref = tF.avg_pool3d(torch.tensor(x), k, stride=s, padding=p,
+                        count_include_pad=False)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_pool3d_grads():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 2, 4, 4, 4)
+    check_grad(lambda t: F.avg_pool3d(t, 2), [x])
+    check_grad(lambda t: F.max_pool3d(t, 2), [x])
+
+
+def test_pool3d_layers_and_adaptive():
+    rng = np.random.RandomState(5)
+    x = paddle.to_tensor(rng.randn(2, 3, 4, 8, 8).astype("float32"))
+    assert nn.MaxPool3D(2)(x).shape == (2, 3, 2, 4, 4)
+    assert nn.AvgPool3D(2)(x).shape == (2, 3, 2, 4, 4)
+    out = F.adaptive_avg_pool3d(x, (2, 4, 2))
+    ref = tF.adaptive_avg_pool3d(torch.tensor(np.asarray(x._value)),
+                                 (2, 4, 2))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-6)
+    assert F.adaptive_max_pool3d(x, 2).shape == (2, 3, 2, 2, 2)
+
+
+# ------------------------------------------------------- conv transpose ----
+
+@pytest.mark.parametrize("stride,pad,opad,dil,groups",
+                         [(2, 1, 0, 1, 1), (3, 0, 1, 1, 1), (1, 2, 0, 2, 1),
+                          (2, 1, 1, 1, 2)])
+def test_conv1d_transpose_matches_torch(stride, pad, opad, dil, groups):
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 4, 9).astype("float32")
+    w = rng.randn(4, 6 // groups, 5).astype("float32")
+    b = rng.randn(6).astype("float32")
+    out = F.conv1d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             paddle.to_tensor(b), stride=stride, padding=pad,
+                             output_padding=opad, dilation=dil,
+                             groups=groups)
+    ref = tF.conv_transpose1d(torch.tensor(x), torch.tensor(w),
+                              torch.tensor(b), stride=stride, padding=pad,
+                              output_padding=opad, dilation=dil,
+                              groups=groups)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_conv3d_transpose_matches_torch():
+    rng = np.random.RandomState(7)
+    x = rng.randn(1, 3, 4, 5, 4).astype("float32")
+    w = rng.randn(3, 2, 3, 3, 3).astype("float32")
+    out = F.conv3d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             stride=2, padding=1)
+    ref = tF.conv_transpose3d(torch.tensor(x), torch.tensor(w), stride=2,
+                              padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_conv_transpose_layers_and_grad():
+    paddle.seed(0)
+    rng = np.random.RandomState(8)
+    layer = nn.Conv1DTranspose(3, 5, 4, stride=2, padding=1)
+    x = paddle.to_tensor(rng.randn(2, 3, 6).astype("float32"))
+    assert layer(x).shape == (2, 5, 12)
+    layer3 = nn.Conv3DTranspose(2, 3, 3, stride=2)
+    x3 = paddle.to_tensor(rng.randn(1, 2, 3, 3, 3).astype("float32"))
+    assert layer3(x3).shape == (1, 3, 7, 7, 7)
+    # numeric grad through x and w
+    xg = rng.randn(1, 2, 5)
+    wg = rng.randn(2, 3, 3)
+    check_grad(lambda a, b: F.conv1d_transpose(a, b, stride=2), [xg, wg])
+
+
+# --------------------------------------------------------- spectral norm ----
+
+def test_spectral_norm_unit_sigma():
+    paddle.seed(0)
+    rng = np.random.RandomState(9)
+    w = rng.randn(6, 4, 3, 3).astype("float32")
+    sn = nn.SpectralNorm(w.shape, dim=0, power_iters=30)
+    out = np.asarray(sn(paddle.to_tensor(w))._value)
+    # after enough power iterations the top singular value is ~1
+    mat = out.reshape(6, -1)
+    assert abs(np.linalg.svd(mat, compute_uv=False)[0] - 1.0) < 1e-3
+    # direction preserved: out is w / sigma
+    sigma = np.linalg.svd(w.reshape(6, -1), compute_uv=False)[0]
+    np.testing.assert_allclose(out, w / sigma, rtol=1e-3, atol=1e-4)
+
+
+def test_spectral_norm_buffers_update_and_jit():
+    import jax
+    paddle.seed(0)
+    rng = np.random.RandomState(10)
+    w = rng.randn(5, 8).astype("float32")
+    sn = nn.SpectralNorm(w.shape, dim=0, power_iters=2)
+    u0 = np.asarray(sn.weight_u._value).copy()
+    sn(paddle.to_tensor(w))
+    assert not np.allclose(u0, np.asarray(sn.weight_u._value))
+
+    # composes under jit via the functional engine contract
+    params, buffers = sn.functional_state()
+
+    def f(buffers, wv):
+        sn.load_functional_state({}, buffers)
+        out = sn(paddle.to_tensor(wv))
+        return out._value, {n: b._value for n, b in sn.named_buffers()}
+
+    out, new_bufs = jax.jit(f)(buffers, w)
+    assert np.isfinite(np.asarray(out)).all()
+    assert set(new_bufs) == set(buffers)
