@@ -21,7 +21,10 @@ from ..core.ragged import RaggedTensor
 __all__ = ["sequence_pool", "sequence_softmax", "sequence_expand",
            "sequence_concat", "sequence_reverse", "sequence_first_step",
            "sequence_last_step", "sequence_slice", "sequence_pad",
-           "sequence_unpad"]
+           "sequence_unpad", "sequence_mask", "sequence_expand_as",
+           "sequence_enumerate", "sequence_erase", "sequence_reshape",
+           "sequence_scatter", "sequence_conv",
+           "sequence_topk_avg_pooling"]
 
 
 def _as_ragged(x, row_splits=None):
@@ -140,3 +143,139 @@ def sequence_unpad(x, lengths):
     from ..core.tensor import Tensor
     vals = x._value if isinstance(x, Tensor) else jnp.asarray(x)
     return RaggedTensor.from_padded(vals, lengths)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    """reference sequence_mask_op.cc: [n] lengths -> [n, maxlen] 0/1 mask.
+    Static-shape friendly: pass maxlen explicitly under jit."""
+    from ..core.dtype import to_jax_dtype
+    from ..core.tensor import Tensor
+    lv = lengths._value if isinstance(lengths, Tensor) \
+        else jnp.asarray(lengths)
+    if maxlen is None:
+        maxlen = int(np.asarray(lv).max())
+    col = jnp.arange(maxlen, dtype=lv.dtype)
+    return (col[None, :] < lv[..., None]).astype(to_jax_dtype(dtype))
+
+
+def sequence_expand_as(x, ref, row_splits=None):
+    """reference sequence_expand_as_op.cc: like sequence_expand but x rows
+    map 1:1 onto ref sequences (x must have nrows rows)."""
+    return sequence_expand(x, ref, row_splits)
+
+
+def sequence_enumerate(x, win_size, pad_value=0, row_splits=None):
+    """reference sequence_enumerate_op.cc: per position, the window of the
+    next win_size ids (padded past each sequence end)."""
+    r = _as_ragged(x, row_splits)
+    ends = r.row_splits[1:]
+    sid = r.segment_ids()
+    pos = jnp.arange(r.values.shape[0], dtype=jnp.int32)
+    cols = []
+    for w in range(win_size):
+        idx = pos + w
+        valid = idx < ends[sid]
+        gathered = r.values[jnp.minimum(idx, r.values.shape[0] - 1)]
+        cols.append(jnp.where(valid, gathered,
+                              jnp.asarray(pad_value, r.values.dtype)))
+    return RaggedTensor(jnp.stack(cols, axis=-1), r.row_splits)
+
+
+def sequence_erase(x, tokens, row_splits=None):
+    """reference sequence_erase_op.cc: drop listed tokens from every
+    sequence (eager: output length is data-dependent)."""
+    r = _as_ragged(x, row_splits)
+    rows = r.to_list()
+    tokens = set(np.asarray(tokens).reshape(-1).tolist())
+    out = []
+    for row in rows:
+        arr = np.asarray(row)
+        keep = ~np.isin(arr, list(tokens))
+        out.append(jnp.asarray(arr[keep]))
+    return RaggedTensor.from_rows(out)
+
+
+def sequence_reshape(x, new_dim, row_splits=None):
+    """reference sequence_reshape_op.cc: re-chunk each sequence's flattened
+    payload into rows of width new_dim (per-sequence element counts must
+    divide new_dim)."""
+    r = _as_ragged(x, row_splits)
+    old_dim = int(np.prod(r.values.shape[1:])) or 1
+    lens = np.asarray(r.lengths)
+    total = lens * old_dim
+    if (total % new_dim).any():
+        raise ValueError("sequence_reshape: per-sequence payload must be "
+                         "divisible by new_dim")
+    new_lens = total // new_dim
+    vals = jnp.reshape(r.values, (-1, new_dim))
+    splits = np.zeros(len(new_lens) + 1, np.int32)
+    np.cumsum(new_lens, out=splits[1:])
+    return RaggedTensor(vals, splits)
+
+
+def sequence_scatter(x, index, updates):
+    """reference sequence_scatter_op.cc: scatter-add `updates` (ragged,
+    per-sequence positions `index`) into dense x rows."""
+    from ..core.tensor import Tensor
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    idx = index if isinstance(index, RaggedTensor) else _as_ragged(index)
+    upd = updates.values if isinstance(updates, RaggedTensor) \
+        else (updates._value if isinstance(updates, Tensor)
+              else jnp.asarray(updates))
+    sid = idx.segment_ids()
+    flat_pos = idx.values.astype(jnp.int32)
+    return xv.at[sid, flat_pos].add(upd.astype(xv.dtype))
+
+
+def sequence_conv(x, weight, context_length, context_start=None,
+                  bias=None, row_splits=None):
+    """reference sequence_conv_op.cc: 1-D conv along each sequence with a
+    [context_length * d_in, d_out] filter; windows never cross sequence
+    boundaries (out-of-sequence taps read 0)."""
+    r = _as_ragged(x, row_splits)
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+    d_in = r.values.shape[-1]
+    sid = r.segment_ids()
+    starts = r.row_splits[:-1]
+    ends = r.row_splits[1:]
+    pos = jnp.arange(r.values.shape[0], dtype=jnp.int32)
+    taps = []
+    for c in range(context_length):
+        idx = pos + context_start + c
+        valid = (idx >= starts[sid]) & (idx < ends[sid])
+        g = r.values[jnp.clip(idx, 0, r.values.shape[0] - 1)]
+        taps.append(jnp.where(valid[:, None], g, 0))
+    ctx = jnp.concatenate(taps, axis=-1)          # [total, ctx*d_in]
+    from ..core.tensor import Tensor
+    w = weight._value if isinstance(weight, Tensor) else jnp.asarray(weight)
+    out = ctx @ w
+    if bias is not None:
+        b = bias._value if isinstance(bias, Tensor) else jnp.asarray(bias)
+        out = out + b
+    return RaggedTensor(out, r.row_splits)
+
+
+def sequence_topk_avg_pooling(x, topks, row_splits=None):
+    """reference sequence_topk_avg_pooling_op.cc: for each sequence and
+    each k in topks, the mean of its top-k values (per feature column)."""
+    r = _as_ragged(x, row_splits)
+    padded = r.to_padded(pad_value=-np.inf)       # [n, maxlen, ...]
+    srt = jnp.sort(padded, axis=1)[:, ::-1]       # descending
+    lens = r.lengths
+    outs = []
+    for k in topks:
+        take = jnp.where(jnp.isfinite(srt[:, :k]), srt[:, :k], 0)
+        cnt = jnp.minimum(lens, k).astype(take.dtype)
+        outs.append(take.sum(axis=1)
+                    / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (take.ndim - 2)))
+    return jnp.stack(outs, axis=1) if len(topks) > 1 else outs[0]
+
+
+# Register in the op inventory (OP_REGISTRY is the OpInfoMap analog). These
+# ops consume/produce RaggedTensor rather than Tensor, so they skip the
+# defop Tensor-lifting wrapper but are first-class op families.
+from ._dispatch import OP_REGISTRY as _REG  # noqa: E402
+
+for _n in __all__:
+    _REG.setdefault(_n, globals()[_n])

@@ -1,0 +1,93 @@
+"""Round-4 sequence_ops completion (reference operators/sequence_ops/:
+sequence_mask, expand_as, enumerate, erase, reshape, scatter, conv,
+topk_avg_pooling)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.ragged import RaggedTensor
+from paddle_tpu.ops import sequence as S
+
+
+def _rag(rows, dtype="float32"):
+    return RaggedTensor.from_rows([np.asarray(r, dtype) for r in rows])
+
+
+def test_sequence_mask():
+    m = S.sequence_mask(paddle.to_tensor(np.array([2, 0, 3])), maxlen=4)
+    np.testing.assert_array_equal(
+        np.asarray(m), [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+
+def test_sequence_enumerate():
+    r = _rag([[1, 2, 3], [4, 5]], "int64")
+    out = S.sequence_enumerate(r, win_size=2, pad_value=0)
+    np.testing.assert_array_equal(
+        np.asarray(out.values),
+        [[1, 2], [2, 3], [3, 0], [4, 5], [5, 0]])
+
+
+def test_sequence_erase():
+    r = _rag([[1, 2, 2, 3], [2, 4]], "int64")
+    out = S.sequence_erase(r, [2])
+    assert out.recursive_sequence_lengths() == [[2, 1]]
+    np.testing.assert_array_equal(np.asarray(out.values), [1, 3, 4])
+
+
+def test_sequence_reshape():
+    r = _rag([[[1, 1], [2, 2], [3, 3]], [[4, 4]]])   # widths 2, lens 3/1
+    out = S.sequence_reshape(r, new_dim=1)
+    assert out.recursive_sequence_lengths() == [[6, 2]]
+    out2 = S.sequence_reshape(out, new_dim=2)
+    assert out2.recursive_sequence_lengths() == [[3, 1]]
+
+
+def test_sequence_scatter():
+    x = np.zeros((2, 5), "float32")
+    idx = RaggedTensor.from_rows([np.array([0, 2], np.int64),
+                                  np.array([1], np.int64)])
+    upd = _rag([[1.0, 3.0], [5.0]])
+    out = S.sequence_scatter(paddle.to_tensor(x), idx, upd)
+    np.testing.assert_array_equal(
+        np.asarray(out), [[1, 0, 3, 0, 0], [0, 5, 0, 0, 0]])
+
+
+def test_sequence_conv_window_stays_in_sequence():
+    # identity filter on the center tap isolates the window logic
+    d = 2
+    r = _rag([[[1, 10], [2, 20], [3, 30]], [[4, 40]]])
+    w = np.zeros((3 * d, d), "float32")
+    w[2, 0] = 1.0   # center tap (c=1), feature 0 -> out 0
+    w[3, 1] = 1.0
+    out = S.sequence_conv(r, w, context_length=3)
+    np.testing.assert_allclose(np.asarray(out.values),
+                               np.asarray(r.values))
+    # edge tap: previous element, zero at sequence starts (no bleed from
+    # the prior sequence)
+    w2 = np.zeros((3 * d, d), "float32")
+    w2[0, 0] = 1.0  # c=0 (offset -1), feature 0
+    out2 = S.sequence_conv(r, w2, context_length=3)
+    vals = np.asarray(out2.values)
+    assert vals[0, 0] == 0.0          # first of seq 0
+    assert vals[1, 0] == 1.0          # sees [1, 10]
+    assert vals[3, 0] == 0.0          # first of seq 1 — no cross-seq bleed
+
+
+def test_sequence_topk_avg_pooling():
+    r = _rag([[3.0, 1.0, 2.0], [5.0]])
+    out = S.sequence_topk_avg_pooling(r, topks=[2])
+    np.testing.assert_allclose(np.asarray(out), [2.5, 5.0])
+
+
+def test_sequence_expand_as():
+    ref = _rag([[1, 1], [2, 2, 2]])
+    x = paddle.to_tensor(np.array([[7.0], [9.0]], "float32"))
+    out = S.sequence_expand_as(x, ref)
+    np.testing.assert_array_equal(np.asarray(out.values).ravel(),
+                                  [7, 7, 9, 9, 9])
+
+
+def test_registry_contains_sequence_family():
+    from paddle_tpu.ops._dispatch import OP_REGISTRY
+    for name in ("sequence_mask", "sequence_conv", "sequence_scatter",
+                 "sequence_enumerate", "sequence_topk_avg_pooling"):
+        assert name in OP_REGISTRY
