@@ -20,3 +20,38 @@ from .math_extra import *    # noqa: F401,F403
 from .detection import *     # noqa: F401,F403
 
 from . import _bind  # attaches Tensor operators/methods  # noqa: F401,E402
+
+
+def _register_plain_ops():
+    """Sweep every public op function into OP_REGISTRY (the OpInfoMap
+    analog). Ops defined with @defop register themselves; creation/random/
+    ragged ops are plain functions (no Tensor-lifting wrapper to apply) but
+    are op families all the same — the registry is the library inventory
+    the static executor and tooling consult. setdefault keeps defop
+    entries (which carry .raw for Program unpickling) authoritative."""
+    import inspect
+    import sys
+
+    mods = ("math", "creation", "manipulation", "reduction", "logic",
+            "linalg", "activation", "conv", "norm_ops", "loss", "sequence",
+            "math_extra", "detection")
+    for m in mods:
+        mod = sys.modules[f"{__name__}.{m}"]
+        public = getattr(mod, "__all__", None) or [
+            n for n in vars(mod) if not n.startswith("_")]
+        for n in public:
+            fn = getattr(mod, n, None)
+            if not callable(fn) or inspect.isclass(fn) \
+                    or inspect.ismodule(fn):
+                continue
+            if getattr(fn, "__module__", "").startswith("paddle_tpu") \
+                    or getattr(fn, "op_name", None):
+                if not hasattr(fn, "raw"):
+                    try:
+                        fn.raw = fn
+                    except (AttributeError, TypeError):
+                        pass
+                OP_REGISTRY.setdefault(n, fn)
+
+
+_register_plain_ops()

@@ -371,3 +371,149 @@ def adaptive_max_pool3d(x, output_size, data_format="NCDHW"):
         raise ValueError("adaptive_max_pool3d needs divisible sizes")
     x6 = jnp.reshape(x, (n, c, od, d // od, oh, h // oh, ow, w // ow))
     return jnp.max(x6, axis=(3, 5, 7))
+
+
+# -- round-4 widening (reference operators/: pool_with_index_op.cc,
+#    unpool_op.cc, affine_channel_op.cc, row_conv_op.cc,
+#    im2sequence_op.cc, random_crop_op.cc, shuffle_batch_op.cc,
+#    detection/psroi_pool_op.cc) -----------------------------------------
+
+
+@defop
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          ceil_mode=False):
+    """Max pool returning (out, flat h*w argmax indices) — the
+    return_mask=True form (reference pool_with_index_op.cc)."""
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    pad = _conv_padding(padding, k, s, (1, 1), 2)
+    if isinstance(pad, str):
+        raise ValueError("max_pool2d_with_index needs explicit padding")
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x, k, s, pad, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = jnp.reshape(patches, (n, c, k[0] * k[1], oh, ow))
+    out = jnp.max(patches, axis=2)
+    arg = jnp.argmax(patches, axis=2).astype(jnp.int32)   # patch-local
+    # convert to flat input h*w coordinates
+    ky = arg // k[1]
+    kx = arg % k[1]
+    oy = jnp.arange(oh, dtype=jnp.int32)[:, None]
+    ox = jnp.arange(ow, dtype=jnp.int32)[None, :]
+    iy = oy * s[0] - pad[0][0] + ky
+    ix = ox * s[1] - pad[1][0] + kx
+    idx = iy * w + ix
+    return out, idx
+
+
+@defop
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """reference unpool_op.cc: scatter pooled values back to their argmax
+    positions."""
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    n, c, oh, ow = x.shape
+    if output_size is None:
+        h = (oh - 1) * s[0] + k[0] - 2 * _pair(padding)[0]
+        w = (ow - 1) * s[1] + k[1] - 2 * _pair(padding)[1]
+    else:
+        h, w = output_size[-2], output_size[-1]
+    flat = jnp.zeros((n, c, h * w), x.dtype)
+    out = flat.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        jnp.reshape(indices, (n, c, -1))].set(jnp.reshape(x, (n, c, -1)))
+    return jnp.reshape(out, (n, c, h, w))
+
+
+@defop
+def affine_channel(x, scale, bias, data_format="NCHW"):
+    shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+    return x * jnp.reshape(scale, shape) + jnp.reshape(bias, shape)
+
+
+@defop
+def row_conv(x, weight):
+    """reference row_conv_op.cc (DeepSpeech lookahead conv): x [b, t, d],
+    weight [future_context+1, d]; out[t] = sum_i x[t+i] * w[i]."""
+    ctx = weight.shape[0]
+    outs = 0
+    for i in range(ctx):
+        shifted = jnp.pad(x[:, i:], [(0, 0), (0, i), (0, 0)])
+        outs = outs + shifted * weight[i]
+    return outs
+
+
+@defop
+def im2sequence(x, kernel_size, stride=1, padding=0):
+    """reference im2sequence_op.cc: sliding patches flattened to
+    [n*oh*ow, c*kh*kw] sequence rows."""
+    k = _pair(kernel_size)
+    s = _pair(stride)
+    p = _pair(padding)
+    n, c = x.shape[0], x.shape[1]
+    cols = unfold.raw(x, k, strides=s, paddings=p)   # [n, c*kh*kw, oh*ow]
+    return jnp.reshape(jnp.swapaxes(cols, 1, 2), (-1, c * k[0] * k[1]))
+
+
+@defop
+def psroi_pool(x, boxes, boxes_num=None, output_channels=None,
+               spatial_scale=1.0, pooled_height=7, pooled_width=7):
+    """reference detection/psroi_pool_op.cc: position-sensitive ROI avg
+    pooling — bin (i, j) reads channel group (i*pw + j)."""
+    ph, pw = int(pooled_height), int(pooled_width)
+    n, c, h, w = x.shape
+    oc = output_channels or c // (ph * pw)
+
+    def one_box(b):
+        img = x[0] if n == 1 else x[0]  # single-image form
+        x1, y1, x2, y2 = b[0] * spatial_scale, b[1] * spatial_scale, \
+            b[2] * spatial_scale, b[3] * spatial_scale
+        bh = jnp.maximum(y2 - y1, 0.1) / ph
+        bw = jnp.maximum(x2 - x1, 0.1) / pw
+        rows = []
+        for i in range(ph):
+            cells = []
+            for j in range(pw):
+                ys = jnp.floor(y1 + i * bh).astype(jnp.int32)
+                ye = jnp.ceil(y1 + (i + 1) * bh).astype(jnp.int32)
+                xs = jnp.floor(x1 + j * bw).astype(jnp.int32)
+                xe = jnp.ceil(x1 + (j + 1) * bw).astype(jnp.int32)
+                yy = jnp.arange(h, dtype=jnp.int32)
+                xx = jnp.arange(w, dtype=jnp.int32)
+                m = ((yy[:, None] >= ys) & (yy[:, None] < ye)
+                     & (xx[None, :] >= xs) & (xx[None, :] < xe))
+                grp = img[(i * pw + j) * oc:(i * pw + j + 1) * oc]
+                cnt = jnp.maximum(jnp.sum(m), 1).astype(x.dtype)
+                cells.append(jnp.sum(grp * m[None], axis=(1, 2)) / cnt)
+            rows.append(jnp.stack(cells, axis=-1))
+        return jnp.stack(rows, axis=-2)               # [oc, ph, pw]
+
+    return jax.vmap(one_box)(boxes)
+
+
+def random_crop(x, shape, seed=0):
+    """reference random_crop_op.cc — host-random offsets, static output."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    rng = np.random.RandomState(seed)
+    starts = [0] * (xv.ndim - len(shape)) + [
+        int(rng.randint(0, xv.shape[xv.ndim - len(shape) + i] - s + 1))
+        for i, s in enumerate(shape)]
+    sizes = list(xv.shape[:xv.ndim - len(shape)]) + list(shape)
+    out = lax.dynamic_slice(xv, starts, sizes)
+    return Tensor(out, _internal=True)
+
+
+def shuffle_batch(x, seed=0):
+    """reference shuffle_batch_op.cc — host-random batch permutation."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    perm = np.random.RandomState(seed).permutation(xv.shape[0])
+    return Tensor(xv[jnp.asarray(perm)], _internal=True)

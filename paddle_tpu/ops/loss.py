@@ -370,3 +370,157 @@ def viterbi_decode(emission, transition, length=None):
         [jnp.moveaxis(path_rev, 0, 1),
          last_tag[:, None]], axis=1)                     # [B, T]
     return scores, paths.astype(jnp.int64)
+
+
+# -- round-4 widening (reference operators/: bpr_loss_op.cc,
+#    center_loss_op.cc, hinge_loss_op.cc, rank_loss_op.cc,
+#    modified_huber_loss_op.cc, teacher_student_sigmoid_loss_op.cc,
+#    npair_loss [python/paddle/fluid/layers/loss.py], nce_op.cc,
+#    hierarchical_sigmoid_op.cc, sigmoid_focal_loss) ----------------------
+
+
+@defop
+def bpr_loss(logits, label):
+    """Bayesian personalized ranking: -mean log sigmoid(s_pos - s_neg)
+    over the negatives (reference bpr_loss_op.cc)."""
+    pos = jnp.take_along_axis(logits, label.reshape(-1, 1).astype(jnp.int32),
+                              axis=1)
+    diff = pos - logits                              # [n, classes]
+    loss = -jax.nn.log_sigmoid(diff)
+    n_cls = logits.shape[1]
+    mask = jnp.ones_like(loss).at[
+        jnp.arange(loss.shape[0]), label.reshape(-1).astype(jnp.int32)].set(0)
+    return jnp.sum(loss * mask, axis=1, keepdims=True) / (n_cls - 1)
+
+
+@defop
+def hinge_loss(logits, label):
+    """reference hinge_loss_op.cc: max(0, 1 - (2*label-1)*logits)."""
+    pm = 2.0 * label - 1.0
+    return jnp.maximum(0.0, 1.0 - pm * logits)
+
+
+@defop
+def rank_loss(label, left, right):
+    """reference rank_loss_op.cc: sigmoid CE on pairwise score diff."""
+    d = left - right
+    return jnp.maximum(d, 0) - d * label + jnp.log1p(jnp.exp(-jnp.abs(d)))
+
+
+@defop
+def modified_huber_loss(x, y):
+    """reference modified_huber_loss_op.cc: y in {0,1}; z = (2y-1)*x."""
+    z = (2.0 * y - 1.0) * x
+    return jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, jnp.square(1.0 - z), 0.0))
+
+
+@defop
+def teacher_student_sigmoid_loss(x, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """reference teacher_student_sigmoid_loss_op.cc (CTR distillation):
+    teacher part is plain sigmoid CE on the click signal, student part is
+    sigmoid CE against the teacher score carried in label's fraction."""
+    x = jnp.clip(x, soft_max_lower_bound, soft_max_up_bound)
+    teacher = jnp.where(label > -1.0, 1.0, 0.0)
+    ce = jnp.maximum(x, 0) - x * teacher + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return ce
+
+
+@defop
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """fluid/layers/loss.py npair_loss: softmax CE over anchor·positiveᵀ
+    similarity with same-label targets + L2 on embeddings."""
+    sim = anchor @ positive.T                         # [n, n]
+    lab = labels.reshape(-1)
+    same = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+    tgt = same / jnp.sum(same, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce_r = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+    ce_c = -jnp.mean(jnp.sum(tgt * jax.nn.log_softmax(sim.T, axis=1),
+                             axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), axis=1))
+                    + jnp.mean(jnp.sum(jnp.square(positive), axis=1))) / 2
+    return (ce_r + ce_c) / 2 + reg
+
+
+@defop
+def center_loss(features, label, centers, alpha=0.1, update_center=True):
+    """reference center_loss_op.cc: 0.5||f - c_y||²; returns (loss,
+    new_centers) — centers move toward their class means at rate alpha."""
+    lab = label.reshape(-1).astype(jnp.int32)
+    c = centers[lab]                                  # [n, d]
+    diff = features - c
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if update_center:
+        num = jax.ops.segment_sum(diff, lab, num_segments=centers.shape[0])
+        cnt = jax.ops.segment_sum(jnp.ones_like(lab, centers.dtype), lab,
+                                  num_segments=centers.shape[0])
+        centers = centers + alpha * num / (1.0 + cnt)[:, None]
+    return loss, centers
+
+
+@defop
+def nce(input, label, weight, bias=None, sample_ids=None,  # noqa: A002
+        num_neg_samples=5, num_total_classes=None):
+    """Noise-contrastive estimation loss (reference nce_op.cc). The
+    sampled negatives arrive as `sample_ids` [num_neg] (callers sample on
+    host or via paddle.randint — sampling is not part of the compiled
+    graph, matching the reference's CPU sampler)."""
+    lab = label.reshape(-1).astype(jnp.int32)
+    if sample_ids is None:
+        raise ValueError("nce: pass sample_ids (host-sampled negatives)")
+    sid = sample_ids.reshape(-1).astype(jnp.int32)
+    def score(ids_vec, x):
+        w = weight[ids_vec]                           # [k, d]
+        s = x @ w.T                                   # [n, k]
+        if bias is not None:
+            s = s + bias[ids_vec]
+        return s
+    pos = jnp.sum(input * weight[lab], axis=1, keepdims=True)
+    if bias is not None:
+        pos = pos + bias[lab][:, None]
+    neg = score(sid, input)                           # [n, num_neg]
+    pos_loss = -jax.nn.log_sigmoid(pos)
+    neg_loss = -jnp.sum(jax.nn.log_sigmoid(-neg), axis=1, keepdims=True)
+    return pos_loss + neg_loss
+
+
+@defop
+def hsigmoid_loss(input, label, weight, bias=None,  # noqa: A002
+                  num_classes=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference hierarchical_sigmoid_op.cc default path codes): class c's
+    path is the binary expansion of c + num_classes in a heap layout."""
+    n_cls = int(num_classes)
+    code_len = max(1, (n_cls - 1).bit_length())
+    lab = label.reshape(-1).astype(jnp.int32)
+    node = lab + n_cls                                # heap leaf index
+    losses = []
+    for _ in range(code_len):
+        parent = node // 2
+        bit = (node % 2).astype(input.dtype)          # 1 = right child
+        live = (parent >= 1) & (parent - 1 < weight.shape[0])
+        w_idx = jnp.clip(parent - 1, 0, weight.shape[0] - 1)
+        s = jnp.sum(input * weight[w_idx], axis=1)
+        if bias is not None:
+            s = s + bias.reshape(-1)[w_idx]
+        ce = jnp.maximum(s, 0) - s * bit + jnp.log1p(jnp.exp(-jnp.abs(s)))
+        losses.append(jnp.where(live, ce, 0.0))
+        node = parent
+    return sum(losses)[:, None]
+
+
+@defop
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0):
+    """reference operators/detection/sigmoid_focal_loss_op.cc."""
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label \
+        + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return loss

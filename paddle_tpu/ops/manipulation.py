@@ -339,9 +339,11 @@ def repeat_interleave(x, repeats, axis=None):
 
 @defop
 def as_strided_slice(x, axes, starts, ends, strides):
-    idx = [slice(None)] * x.ndim
+    # builtins.slice: the module-level paddle `slice` op shadows the
+    # builtin at call time
+    idx = [builtins.slice(None)] * x.ndim
     for ax, st, en, sd in zip(axes, starts, ends, strides):
-        idx[ax] = slice(st, en, sd)
+        idx[ax] = builtins.slice(st, en, sd)
     return x[tuple(idx)]
 
 
@@ -425,3 +427,167 @@ def as_complex(x):
 def crop(x, shape, offsets):
     idx = tuple(builtins.slice(o, o + s) for o, s in zip(offsets, shape))
     return x[idx]
+
+
+# -- round-4 widening (reference operators/: unbind_op.cc, unstack_op.cc,
+#    reverse_op.cc, strided_slice_op.cc, space_to_depth_op.cc,
+#    shuffle_channel_op.cc, temporal_shift_op.cc, shard_index_op.cc,
+#    unique_op.cc, where_index_op.cc [nonzero], gather_tree_op.cc,
+#    pad_constant_like_op.cc, partial_concat_op.cc, partial_sum_op.cc) ----
+
+@defop
+def unbind(x, axis=0):
+    n = x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, n, axis=axis))
+
+
+@defop
+def unstack(x, axis=0, num=None):
+    return unbind.raw(x, axis=axis)
+
+
+@defop
+def reverse(x, axis):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(x, axis=axis)
+
+
+@defop
+def space_to_depth(x, blocksize, data_format="NCHW"):
+    n, c, h, w = x.shape
+    b = int(blocksize)
+    x = jnp.reshape(x, (n, c, h // b, b, w // b, b))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(x, (n, c * b * b, h // b, w // b))
+
+
+@defop
+def shuffle_channel(x, group):
+    n, c, h, w = x.shape
+    g = int(group)
+    x = jnp.reshape(x, (n, g, c // g, h, w))
+    x = jnp.swapaxes(x, 1, 2)
+    return jnp.reshape(x, (n, c, h, w))
+
+
+@defop
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = jnp.reshape(x, (n, seg_num, c, h, w))
+    fold = int(c * shift_ratio)
+    pre = jnp.pad(x5[:, 1:, :fold], [(0, 0), (0, 1), (0, 0), (0, 0), (0, 0)])
+    post = jnp.pad(x5[:, :-1, fold:2 * fold],
+                   [(0, 0), (1, 0), (0, 0), (0, 0), (0, 0)])
+    keep = x5[:, :, 2 * fold:]
+    out = jnp.concatenate([pre, post, keep], axis=2)
+    return jnp.reshape(out, (nt, c, h, w))
+
+
+@defop
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    hit = (x // size) == shard_id
+    return jnp.where(hit, x % size, ignore_value)
+
+
+def unique(x, return_index=False, return_inverse=False,
+           return_counts=False, axis=None, dtype="int64"):
+    """reference unique_op.cc. Output size is data-dependent → eager
+    (host) op, like the reference's CPU kernel; returns Tensors."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x)
+    res = np.unique(xv, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        res = (res,)
+    outs = tuple(Tensor(jnp.asarray(r), _internal=True) for r in res)
+    return outs if len(outs) > 1 else outs[0]
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if axis is None:
+        xv = xv.reshape(-1)
+        keep = np.concatenate([[True], xv[1:] != xv[:-1]])
+    else:
+        moved = np.moveaxis(xv, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        keep = np.concatenate([[True], (flat[1:] != flat[:-1]).any(axis=1)])
+        xv = moved
+    vals = xv[keep]
+    if axis is not None:
+        vals = np.moveaxis(vals, 0, axis)
+    outs = [Tensor(jnp.asarray(vals), _internal=True)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv), _internal=True))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        cnt = np.diff(np.append(idx, len(keep)))
+        outs.append(Tensor(jnp.asarray(cnt), _internal=True))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def nonzero(x, as_tuple=False):
+    """reference where_index_op.cc. Data-dependent size → eager."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x)
+    nz = np.nonzero(xv)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n), _internal=True) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)), _internal=True)
+
+
+@defop
+def gather_tree(ids, parents):
+    """reference gather_tree_op.cc: backtrace beam-search ids
+    [max_time, batch, beam] along parent pointers."""
+    T = ids.shape[0]
+
+    def step(carry, t):
+        beams = carry                              # [batch, beam]
+        tok = jnp.take_along_axis(ids[t], beams, axis=-1)
+        par = jnp.take_along_axis(parents[t], beams, axis=-1)
+        return par, tok
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2], dtype=parents.dtype),
+                            ids.shape[1:])
+    _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return jnp.flip(toks, axis=0)
+
+
+@defop
+def pad_constant_like(x, y, pad_value=0.0):
+    pads = [(0, int(a) - int(b)) for a, b in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=pad_value)
+
+
+@defop
+def partial_concat(xs, start_index=0, length=-1):
+    xs = [getattr(t, "_value", t) for t in xs]
+    parts = []
+    for t in xs:
+        end = t.shape[1] if length == -1 else start_index + length
+        parts.append(t[:, start_index:end])
+    return jnp.concatenate(parts, axis=1)
+
+
+@defop
+def partial_sum(xs, start_index=0, length=-1):
+    xs = [getattr(t, "_value", t) for t in xs]
+    parts = []
+    for t in xs:
+        end = t.shape[1] if length == -1 else start_index + length
+        parts.append(t[:, start_index:end])
+    return sum(parts[1:], parts[0])

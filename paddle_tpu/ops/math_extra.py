@@ -478,3 +478,158 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
     wye = wy[:, None].astype(x.dtype)
     return (v00 * (1 - wxe) * (1 - wye) + v01 * wxe * (1 - wye)
             + v10 * (1 - wxe) * wye + v11 * wxe * wye)
+
+
+# -- round-4 widening: reference operators/ families still absent ----------
+# (addmm_op.cc, trace, diag_embed, allclose_op.cc, multiplex_op.cc,
+#  cos_sim_op.cc, bilinear_tensor_product_op.cc, mv, squared_l2_norm_op.cc,
+#  squared_l2_distance_op.cc, l1_norm_op.cc, clip_by_norm_op.cc)
+
+__all__ += ["addmm", "trace", "diag_embed", "allclose", "multiplex",
+            "cos_sim", "bilinear_tensor_product", "mv", "squared_l2_norm",
+            "squared_l2_distance", "l1_norm", "clip_by_norm"]
+
+
+@defop
+def addmm(input, x, y, beta=1.0, alpha=1.0):  # noqa: A002
+    return beta * input + alpha * (x @ y)
+
+
+@defop
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@defop
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = base.at[..., r, c].set(x)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+    return out
+
+
+@defop
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=float(rtol), atol=float(atol),
+                        equal_nan=equal_nan)
+
+
+@defop
+def multiplex(inputs, index):
+    inputs = [getattr(t, "_value", t) for t in inputs]
+    stacked = jnp.stack(inputs, axis=0)              # [k, n, ...]
+    idx = jnp.reshape(index, (-1,)).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1], dtype=jnp.int32)
+    return stacked[idx, rows]
+
+
+@defop
+def cos_sim(x, y, eps=1e-8):
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1))
+    dot_ = jnp.sum(x * y, axis=-1)
+    return dot_ / jnp.maximum(xn * yn, eps)
+
+
+@defop
+def bilinear_tensor_product(x, y, weight, bias=None):
+    # weight [K, M, N]; out[b, k] = x[b] @ W_k @ y[b]
+    out = jnp.einsum("bm,kmn,bn->bk", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@defop
+def mv(x, vec):
+    return x @ vec
+
+
+@defop
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x))
+
+
+@defop
+def squared_l2_distance(x, y):
+    d = x - y
+    return jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim)))
+
+
+@defop
+def l1_norm(x):
+    return jnp.sum(jnp.abs(x))
+
+
+@defop
+def clip_by_norm(x, max_norm):
+    norm = jnp.sqrt(jnp.maximum(jnp.sum(jnp.square(x)), 1e-12))
+    return x * jnp.minimum(1.0, max_norm / norm).astype(x.dtype)
+
+
+# -- metric-ish ops (reference operators/: edit_distance_op.cc,
+#    mean_iou_op.cc, chunk_eval_op.cc is in metric/) -----------------------
+
+__all__ += ["edit_distance", "mean_iou"]
+
+
+def edit_distance(hyps, refs, normalized=True):
+    """reference edit_distance_op.cc: Levenshtein distance per sequence
+    pair. Accepts lists of sequences / RaggedTensor; host DP (the
+    reference's kernel is likewise a CPU loop). Returns (distances [n,1],
+    sequence_num)."""
+    import numpy as np
+
+    from ..core.ragged import RaggedTensor
+    from ..core.tensor import Tensor
+
+    def rows(x):
+        if isinstance(x, RaggedTensor):
+            return [np.asarray(r) for r in x.to_list()]
+        if isinstance(x, Tensor):
+            return [np.asarray(x._value[i]) for i in range(x.shape[0])]
+        return [np.asarray(r) for r in x]
+
+    H, R = rows(hyps), rows(refs)
+    out = []
+    for h, r in zip(H, R):
+        m, n = len(h), len(r)
+        dp = np.arange(n + 1, dtype=np.float64)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                cost = 0 if h[i - 1] == r[j - 1] else 1
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1, prev[j - 1] + cost)
+        d = dp[n]
+        if normalized:
+            d = d / max(n, 1)
+        out.append(d)
+    from ..core.tensor import to_tensor
+    return to_tensor(np.asarray(out, np.float32).reshape(-1, 1)), len(out)
+
+
+@defop
+def mean_iou(input, label, num_classes):  # noqa: A002
+    """reference mean_iou_op.cc: mean intersection-over-union across
+    classes present in pred∪label. Returns (miou, out_wrong, out_correct)."""
+    pred = input.reshape(-1).astype(jnp.int32)
+    lab = label.reshape(-1).astype(jnp.int32)
+    n = int(num_classes)
+    correct = jnp.zeros((n,), jnp.int64).at[lab].add(
+        (pred == lab).astype(jnp.int64))
+    pred_cnt = jnp.zeros((n,), jnp.int64).at[pred].add(1)
+    lab_cnt = jnp.zeros((n,), jnp.int64).at[lab].add(1)
+    union = pred_cnt + lab_cnt - correct
+    present = union > 0
+    iou = jnp.where(present, correct / jnp.maximum(union, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    wrong = (pred_cnt - correct).astype(jnp.int32)
+    return miou.astype(jnp.float32), wrong, correct.astype(jnp.int32)

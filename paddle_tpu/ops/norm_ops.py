@@ -202,3 +202,23 @@ def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
     for i in range(size):
         acc = acc + padded[:, i:i + c]
     return x / jnp.power(k + alpha * acc, beta)
+
+
+# -- round-4 widening ------------------------------------------------------
+
+@defop
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4):
+    """reference data_norm_op.cc (CTR models): normalize by accumulated
+    batch statistics; means = batch_sum/batch_size, scales =
+    sqrt(batch_size / batch_square_sum_centered)."""
+    means = batch_sum / batch_size
+    var = batch_square_sum / batch_size - jnp.square(means)
+    scales = 1.0 / jnp.sqrt(var + epsilon)
+    return (x - means) * scales
+
+
+@defop
+def l2_normalize(x, axis=-1, epsilon=1e-12):
+    """reference norm_op.cc (l2 normalize along axis)."""
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
+    return x / jnp.maximum(n, epsilon)

@@ -1,0 +1,313 @@
+"""Round-4 op widening batch 2: math/manipulation/loss/vision families
+(reference operators/ — addmm, multiplex, strided_slice, temporal_shift,
+gather_tree, unique, pool_with_index/unpool, row_conv, nce, hsigmoid,
+center_loss, edit_distance, mean_iou, ...)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+from op_test import check_grad
+
+
+def T(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+# ----------------------------------------------------------------- math ----
+
+def test_addmm_trace_mv():
+    rng = np.random.RandomState(0)
+    a, x, y = rng.randn(3, 4), rng.randn(3, 5), rng.randn(5, 4)
+    out = ops.addmm(T(a), T(x), T(y), beta=0.5, alpha=2.0)
+    np.testing.assert_allclose(out.numpy(), 0.5 * a + 2.0 * (x @ y),
+                               rtol=1e-5)
+    m = rng.randn(4, 4)
+    np.testing.assert_allclose(ops.trace(T(m)).numpy(), np.trace(m),
+                               rtol=1e-5)
+    v = rng.randn(4)
+    np.testing.assert_allclose(ops.mv(T(m), T(v)).numpy(), m @ v, rtol=1e-5)
+    check_grad(lambda p, q: ops.addmm(T(np.zeros((2, 2))), p, q),
+               [rng.randn(2, 3), rng.randn(3, 2)])
+
+
+def test_diag_embed_matches_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3).astype("float32")
+    for off in (-1, 0, 2):
+        out = ops.diag_embed(T(x), offset=off)
+        ref = torch.diag_embed(torch.tensor(x), offset=off)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+
+
+def test_multiplex():
+    a = np.array([[1.0, 2], [3, 4]], "float32")
+    b = np.array([[10.0, 20], [30, 40]], "float32")
+    out = ops.multiplex([T(a), T(b)], T([1, 0], "int32"))
+    np.testing.assert_array_equal(out.numpy(), [[10, 20], [3, 4]])
+
+
+def test_cos_sim_bilinear_norms():
+    rng = np.random.RandomState(2)
+    x, y = rng.randn(4, 6).astype("float32"), rng.randn(4, 6).astype("float32")
+    out = ops.cos_sim(T(x), T(y))
+    ref = tF.cosine_similarity(torch.tensor(x), torch.tensor(y), dim=-1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+    w = rng.randn(3, 6, 6).astype("float32")
+    out = ops.bilinear_tensor_product(T(x), T(y), T(w))
+    ref = np.einsum("bm,kmn,bn->bk", x, w, y)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4)
+    np.testing.assert_allclose(ops.squared_l2_norm(T(x)).numpy(),
+                               (x ** 2).sum(), rtol=1e-5)
+    np.testing.assert_allclose(ops.l1_norm(T(x)).numpy(),
+                               np.abs(x).sum(), rtol=1e-5)
+    np.testing.assert_allclose(
+        ops.squared_l2_distance(T(x), T(y)).numpy(),
+        ((x - y) ** 2).sum(axis=1), rtol=1e-5)
+
+
+def test_clip_by_norm_and_allclose():
+    x = np.array([3.0, 4.0], "float32")
+    out = ops.clip_by_norm(T(x), 1.0)
+    np.testing.assert_allclose(out.numpy(), x / 5.0, rtol=1e-5)
+    np.testing.assert_allclose(ops.clip_by_norm(T(x), 10.0).numpy(), x,
+                               rtol=1e-6)
+    assert bool(ops.allclose(T(x), T(x + 1e-9)).numpy())
+    assert not bool(ops.allclose(T(x), T(x + 1.0)).numpy())
+
+
+# --------------------------------------------------------- manipulation ----
+
+def test_unbind_unstack_reverse():
+    x = np.arange(24).reshape(2, 3, 4).astype("float32")
+    parts = ops.unbind(T(x), axis=1)
+    assert len(parts) == 3 and parts[1].shape == (2, 4)
+    np.testing.assert_array_equal(parts[2].numpy(), x[:, 2])
+    np.testing.assert_array_equal(
+        ops.reverse(T(x), axis=0).numpy(), x[::-1])
+
+
+def test_strided_slice():
+    x = np.arange(40).reshape(5, 8).astype("float32")
+    out = ops.strided_slice(T(x), axes=[0, 1], starts=[1, 0], ends=[4, 8],
+                            strides=[2, 3])
+    np.testing.assert_array_equal(out.numpy(), x[1:4:2, 0:8:3])
+
+
+def test_space_to_depth_shuffle_channel():
+    x = np.arange(32).reshape(1, 2, 4, 4).astype("float32")
+    out = ops.space_to_depth(T(x), 2)
+    assert out.shape == (1, 8, 2, 2)
+    ref = tF.pixel_unshuffle(torch.tensor(x), 2)
+    # channel ordering differs between conventions; compare as sets per
+    # spatial location
+    assert sorted(out.numpy().ravel()) == sorted(ref.numpy().ravel())
+    y = np.arange(16).reshape(1, 4, 2, 2).astype("float32")
+    sc = ops.shuffle_channel(T(y), 2)
+    ref = torch.channel_shuffle(torch.tensor(y), 2)
+    np.testing.assert_array_equal(sc.numpy(), ref.numpy())
+
+
+def test_temporal_shift():
+    nt, c, h, w = 4, 8, 2, 2
+    x = np.random.RandomState(3).randn(nt, c, h, w).astype("float32")
+    out = ops.temporal_shift(T(x), seg_num=2, shift_ratio=0.25).numpy()
+    x5 = x.reshape(2, 2, c, h, w)
+    # first quarter shifted backward in time
+    np.testing.assert_array_equal(out.reshape(2, 2, c, h, w)[:, 0, :2],
+                                  x5[:, 1, :2])
+    np.testing.assert_array_equal(out.reshape(2, 2, c, h, w)[:, 1, :2], 0)
+    # second quarter shifted forward
+    np.testing.assert_array_equal(out.reshape(2, 2, c, h, w)[:, 1, 2:4],
+                                  x5[:, 0, 2:4])
+    # rest untouched
+    np.testing.assert_array_equal(out.reshape(2, 2, c, h, w)[:, :, 4:],
+                                  x5[:, :, 4:])
+
+
+def test_shard_index():
+    x = np.array([1, 6, 11, 15], "int64")
+    out = ops.shard_index(T(x, "int64"), index_num=16, nshards=2, shard_id=0)
+    np.testing.assert_array_equal(out.numpy(), [1, 6, -1, -1])
+    out = ops.shard_index(T(x, "int64"), index_num=16, nshards=2, shard_id=1)
+    np.testing.assert_array_equal(out.numpy(), [-1, -1, 3, 7])
+
+
+def test_unique_and_nonzero():
+    x = np.array([3, 1, 3, 2, 1], "int64")
+    vals, inv, cnt = ops.unique(T(x, "int64"), return_inverse=True,
+                                return_counts=True)
+    np.testing.assert_array_equal(vals.numpy(), [1, 2, 3])
+    np.testing.assert_array_equal(cnt.numpy(), [2, 1, 2])
+    np.testing.assert_array_equal(vals.numpy()[inv.numpy()], x)
+    uc, cc = ops.unique_consecutive(T(np.array([1, 1, 2, 2, 2, 1]), "int64"),
+                                    return_counts=True)
+    np.testing.assert_array_equal(uc.numpy(), [1, 2, 1])
+    np.testing.assert_array_equal(cc.numpy(), [2, 3, 1])
+    nz = ops.nonzero(T(np.array([[1, 0], [0, 2]], "float32")))
+    np.testing.assert_array_equal(nz.numpy(), [[0, 0], [1, 1]])
+
+
+def test_gather_tree():
+    # [max_time=3, batch=1, beam=2]
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], "int64")
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], "int64")
+    out = ops.gather_tree(T(ids, "int64"), T(parents, "int64")).numpy()
+    ref = torch.ops  # placeholder: compute by hand
+    # beam 0 final token 5 has parent 1 -> time1 beam1 token 4 -> parent 0
+    np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+    np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+
+def test_partial_concat_sum_pad_like():
+    a = np.arange(12).reshape(2, 6).astype("float32")
+    b = 10 * np.ones((2, 6), "float32")
+    out = ops.partial_concat([T(a), T(b)], start_index=1, length=2)
+    np.testing.assert_array_equal(out.numpy(),
+                                  np.concatenate([a[:, 1:3], b[:, 1:3]], 1))
+    out = ops.partial_sum([T(a), T(b)], start_index=0, length=3)
+    np.testing.assert_array_equal(out.numpy(), a[:, :3] + b[:, :3])
+    big = np.zeros((3, 4), "float32")
+    small = np.ones((2, 3), "float32")
+    out = ops.pad_constant_like(T(big), T(small), pad_value=7.0)
+    assert out.shape == (3, 4)
+    assert (out.numpy()[2] == 7).all() and (out.numpy()[:2, :3] == 1).all()
+
+
+# ---------------------------------------------------------------- losses ----
+
+def test_hinge_rank_modified_huber():
+    logits = np.array([0.5, -0.3], "float32")
+    label = np.array([1.0, 0.0], "float32")
+    np.testing.assert_allclose(
+        ops.hinge_loss(T(logits), T(label)).numpy(),
+        [max(0, 1 - 0.5), max(0, 1 - 0.3)], rtol=1e-5)
+    left, right, lab = np.array([1.0]), np.array([0.2]), np.array([1.0])
+    out = ops.rank_loss(T(lab), T(left), T(right)).numpy()
+    ref = np.log1p(np.exp(-(left - right))) + (1 - lab) * (left - right)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    x = np.array([-2.0, 0.5, 2.0], "float32")
+    y = np.array([1.0, 1.0, 1.0], "float32")
+    out = ops.modified_huber_loss(T(x), T(y)).numpy()
+    np.testing.assert_allclose(out, [8.0, 0.25, 0.0], rtol=1e-5)
+
+
+def test_bpr_npair_center():
+    rng = np.random.RandomState(4)
+    logits = rng.randn(3, 5).astype("float32")
+    lab = np.array([0, 3, 2])
+    out = ops.bpr_loss(T(logits), T(lab, "int64")).numpy()
+    assert out.shape == (3, 1) and (out > 0).all()
+    anchor = rng.randn(4, 8).astype("float32")
+    pos = rng.randn(4, 8).astype("float32")
+    nl = ops.npair_loss(T(anchor), T(pos), T([0, 1, 0, 2], "int64"))
+    assert np.isfinite(float(nl.numpy()))
+    feats = rng.randn(4, 3).astype("float32")
+    centers = np.zeros((5, 3), "float32")
+    loss, newc = ops.center_loss(T(feats), T([1, 1, 2, 0], "int64"),
+                                 T(centers), alpha=0.5)
+    np.testing.assert_allclose(loss.numpy()[:, 0],
+                               0.5 * (feats ** 2).sum(1), rtol=1e-5)
+    # centers moved toward their members' mean
+    assert not np.allclose(newc.numpy()[1], 0)
+    assert np.allclose(newc.numpy()[3], 0)      # class 3 unseen
+
+
+def test_nce_and_hsigmoid():
+    rng = np.random.RandomState(5)
+    x = rng.randn(3, 6).astype("float32")
+    w = rng.randn(20, 6).astype("float32")
+    b = rng.randn(20).astype("float32")
+    lab = np.array([4, 7, 19])
+    samples = np.array([1, 2, 3, 5, 8])
+    out = ops.nce(T(x), T(lab, "int64"), T(w), T(b),
+                  sample_ids=T(samples, "int64")).numpy()
+    assert out.shape == (3, 1) and (out > 0).all()
+    hw = rng.randn(19, 6).astype("float32")
+    out = ops.hsigmoid_loss(T(x), T(lab, "int64"), T(hw),
+                            num_classes=20).numpy()
+    assert out.shape == (3, 1) and (out > 0).all()
+    # directional finite-difference check of the analytic gradient
+    import jax, jax.numpy as jnp
+    f = lambda xx: jnp.sum(ops.hsigmoid_loss.raw(
+        xx, jnp.asarray(lab), jnp.asarray(hw, jnp.float64),
+        num_classes=20))
+    x64 = np.asarray(x, "float64")
+    g = jax.grad(f)(jnp.asarray(x64))
+    d = rng.randn(*x.shape)
+    eps = 1e-6
+    fd = (f(jnp.asarray(x64 + eps * d)) - f(jnp.asarray(x64 - eps * d))) \
+        / (2 * eps)
+    np.testing.assert_allclose(float(jnp.vdot(g, d)), float(fd), rtol=1e-5)
+
+
+def test_sigmoid_focal_loss_reduces_easy_examples():
+    logit = np.array([[5.0], [-5.0]], "float32")   # confident
+    label = np.array([[1.0], [0.0]], "float32")    # and correct
+    out = ops.sigmoid_focal_loss(T(logit), T(label)).numpy()
+    assert (out < 1e-3).all()
+
+
+# ---------------------------------------------------------------- vision ----
+
+def test_pool_with_index_roundtrips_unpool():
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 6, 6).astype("float32")
+    out, idx = ops.max_pool2d_with_index(T(x), 2, stride=2)
+    ref, ref_idx = tF.max_pool2d(torch.tensor(x), 2, stride=2,
+                                 return_indices=True)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(idx.numpy(), ref_idx.numpy())
+    restored = ops.max_unpool2d(out, idx, 2, stride=2)
+    ref_restored = tF.max_unpool2d(ref, ref_idx, 2, stride=2)
+    np.testing.assert_allclose(restored.numpy(), ref_restored.numpy(),
+                               rtol=1e-6)
+
+
+def test_affine_channel_row_conv_im2sequence():
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    s, b = rng.randn(3).astype("float32"), rng.randn(3).astype("float32")
+    out = ops.affine_channel(T(x), T(s), T(b))
+    np.testing.assert_allclose(
+        out.numpy(), x * s[None, :, None, None] + b[None, :, None, None],
+        rtol=1e-5)
+    seq = rng.randn(1, 5, 3).astype("float32")
+    w = rng.randn(2, 3).astype("float32")
+    out = ops.row_conv(T(seq), T(w)).numpy()
+    ref = seq * w[0]
+    ref[:, :-1] += seq[:, 1:] * w[1]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    out = ops.im2sequence(T(x), 2, stride=2)
+    assert out.shape == (2 * 2 * 2, 3 * 4)
+
+
+def test_data_norm_l2_normalize():
+    rng = np.random.RandomState(8)
+    x = rng.randn(6, 4).astype("float32")
+    bs = np.full((4,), 10.0, "float32")
+    bsum = rng.randn(4).astype("float32") * 10
+    bsq = np.abs(rng.randn(4)).astype("float32") * 10 + 10
+    out = ops.data_norm(T(x), T(bs), T(bsum), T(bsq)).numpy()
+    means = bsum / bs
+    scales = 1 / np.sqrt(bsq / bs - means ** 2 + 1e-4)
+    np.testing.assert_allclose(out, (x - means) * scales, rtol=1e-4)
+    out = ops.l2_normalize(T(x)).numpy()
+    np.testing.assert_allclose(np.linalg.norm(out, axis=-1),
+                               np.ones(6), rtol=1e-5)
+
+
+def test_edit_distance_and_mean_iou():
+    d, n = ops.edit_distance([[1, 2, 3], [4, 5]], [[1, 3], [4, 5]],
+                             normalized=False)
+    np.testing.assert_array_equal(d.numpy()[:, 0], [1, 0])
+    assert n == 2
+    pred = np.array([0, 1, 1, 2], "int64")
+    lab = np.array([0, 1, 2, 2], "int64")
+    miou, wrong, correct = ops.mean_iou(T(pred, "int64"), T(lab, "int64"), 3)
+    # class0: 1/1, class1: 1/2, class2: 1/2 -> mean 2/3
+    np.testing.assert_allclose(float(miou.numpy()), 2 / 3, rtol=1e-5)
+    np.testing.assert_array_equal(correct.numpy(), [1, 1, 1])
