@@ -106,6 +106,17 @@ class PSClient:
     def table_state(self, table, server=0):
         return self._conns[server].call("table_state", table=table)
 
+    def save_snapshot(self, path):
+        """Ask every server to snapshot its tables to server-local disk
+        (file per server: {path}.s{i}); mid-train fault tolerance
+        (reference large_scale_kv.h checkpointing)."""
+        return [c.call("save_snapshot", path=f"{path}.s{i}")
+                for i, c in enumerate(self._conns)]
+
+    def load_snapshot(self, path):
+        return [c.call("load_snapshot", path=f"{path}.s{i}")
+                for i, c in enumerate(self._conns)]
+
     def stop_servers(self):
         for c in self._conns:
             try:

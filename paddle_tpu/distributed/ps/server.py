@@ -64,6 +64,30 @@ class PSServer:
             return "pong"
         if method == "list_tables":
             return {n: type(t).__name__ for n, t in self._tables.items()}
+        if method == "save_snapshot":
+            # mid-train fault-tolerance snapshot (reference
+            # operators/distributed/large_scale_kv.h SaveToSelectedRows /
+            # table checkpointing): every table's full state to local disk,
+            # written atomically (tmp + rename)
+            import os
+            import pickle
+            path = req["path"]
+            state = {n: t.state() for n, t in self._tables.items()
+                     if hasattr(t, "state")}
+            tmp = f"{path}.tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f, protocol=4)
+            os.replace(tmp, path)
+            return sorted(state)
+        if method == "load_snapshot":
+            import pickle
+            with open(req["path"], "rb") as f:
+                state = pickle.load(f)  # noqa: S301 — server-local file
+            for n, st in state.items():
+                if n in self._tables and hasattr(self._tables[n],
+                                                 "load_state"):
+                    self._tables[n].load_state(st)
+            return sorted(state)
         t = self._tables[req.pop("table")]
         if method == "pull_dense":
             return t.pull()

@@ -156,7 +156,8 @@ class Executor:
     # -- dataset/trainer path ------------------------------------------------
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           ps_config=None):
         """The industrial hot path (reference executor.py:1425
         _run_from_dataset -> framework/executor.cc:165 RunFromDataset ->
         HogwildWorker::TrainFiles hogwild_worker.cc:196).
@@ -165,14 +166,38 @@ class Executor:
         card, each looping ops over channel batches; on the
         single-controller runtime ONE loop drives the whole mesh — the
         compiled step is already data-parallel over the devices, and the
-        dataset's thread pool keeps the parse ahead of the step."""
+        dataset's thread pool keeps the parse ahead of the step.
+
+        ps_config enables the Downpour loop (reference
+        framework/downpour_worker.cc: pull sparse rows before each batch,
+        run, push sparse grads after):
+          {"client": PSClient, "communicator": Communicator | None,
+           "sparse": [{"param": var_name, "slot": feed_slot,
+                       "table": table_name}]}
+        PS-managed params are pulled into the scope for the batch's ids,
+        their grads are fetched and pushed as (ids, rows) pairs, and they
+        are EXCLUDED from the program's local optimizer section — the
+        server's accessor owns the update rule."""
         if dataset is None:
             raise ValueError("train_from_dataset requires a dataset")
         from ..core import monitor as _monitor
+        program_ = program if not isinstance(program, CompiledProgram) \
+            else program.program
+        from .program import default_main_program
+        dp = _DownpourDriver(program_ or default_main_program(),
+                             scope, ps_config) if ps_config else None
+        base_fetch = list(fetch_list or [])
         it = 0
         for feed in dataset.batches():
-            outs = self.run(program, feed=feed, fetch_list=fetch_list,
+            if dp is not None:
+                feed = dp.pre_step(feed)
+            outs = self.run(program, feed=feed,
+                            fetch_list=base_fetch + (dp.grad_fetches
+                                                     if dp else []),
                             scope=scope)
+            if dp is not None:
+                dp.post_step(outs[len(base_fetch):])
+                outs = outs[:len(base_fetch)]
             _monitor.stat_add("executor/dataset_batches")
             it += 1
             if debug or (fetch_list and print_period
@@ -182,6 +207,8 @@ class Executor:
                 msg = ", ".join(f"{n}={np.asarray(v).mean():.6f}"
                                 for n, v in zip(names, outs))
                 print(f"batch {it}: {msg}")
+        if dp is not None:
+            dp.flush()
         return None
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
@@ -362,3 +389,79 @@ class Executor:
                     donate_argnums=donate)
 
         return jitted, persist_names, opt
+
+
+class _DownpourDriver:
+    """Per-batch sparse pull/push around the compiled step (reference
+    framework/downpour_worker.cc FillSparseValue / push_sparse; N11/N22).
+
+    The PS-managed embedding param stays a scope var; before each batch
+    the rows the batch touches are pulled from the server into it, after
+    the step those rows of its gradient are pushed back (optionally via
+    the async Communicator). The param is removed from the local optimizer
+    section — the server-side accessor (sgd/adagrad/adam) owns the update,
+    exactly the reference's division of labor."""
+
+    def __init__(self, program, scope, ps_config):
+        from .program import global_scope
+        self.scope = scope or global_scope()
+        self.client = ps_config["client"]
+        self.comm = ps_config.get("communicator")
+        self.specs = [dict(s) for s in ps_config.get("sparse", [])]
+        for s in self.specs:
+            target = s["param"]
+            pv = None
+            for v in program.persistable_vars.values():
+                if v.name == target \
+                        or getattr(v, "scope_name", None) == target:
+                    pv = v
+                    break
+            if pv is None:
+                raise ValueError(
+                    f"ps_config param {target!r} is not a persistable var "
+                    f"of the program")
+            s["_name"] = pv.name
+            s["_scope"] = getattr(pv, "scope_name", pv.name)
+        ps_names = {s["_name"] for s in self.specs}
+        if program.optimizer_section:
+            opt, pairs = program.optimizer_section
+            keep = [(p, g) for p, g in pairs if p.name not in ps_names]
+            if len(keep) != len(pairs):
+                program.optimizer_section = (opt, keep)
+                program._version += 1
+        self.grad_fetches = []
+        bw = getattr(program, "backward_section", None)
+        bw_pairs = bw[1] if bw else []
+        for s in self.specs:
+            gvar = next((g for p, g in bw_pairs if p.name == s["_name"]),
+                        None)
+            if gvar is None:
+                raise ValueError(
+                    f"ps_config param {s['param']!r} has no grad var — "
+                    "run minimize()/append_backward over it")
+            self.grad_fetches.append(gvar)
+        self._pulled = [None] * len(self.specs)
+
+    def pre_step(self, feed):
+        import jax.numpy as jnp
+        for i, s in enumerate(self.specs):
+            ids = np.asarray(feed[s["slot"]]).reshape(-1)
+            uniq = np.unique(ids.astype(np.int64))
+            rows = self.client.pull_sparse(s["table"], uniq)
+            w = self.scope.get(s["_scope"])
+            self.scope.set(s["_scope"], jnp.asarray(w).at[
+                jnp.asarray(uniq)].set(jnp.asarray(rows, w.dtype)))
+            self._pulled[i] = uniq
+        return feed
+
+    def post_step(self, grad_outs):
+        for s, uniq, g in zip(self.specs, self._pulled, grad_outs):
+            rows_g = np.asarray(g)[uniq]
+            if self.comm is not None:
+                self.comm.push_sparse(s["table"], uniq, rows_g)
+            else:
+                self.client.push_sparse_grad(s["table"], uniq, rows_g)
+
+    def flush(self):
+        if self.comm is not None:
+            self.comm.flush()

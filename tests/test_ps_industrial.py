@@ -1,0 +1,159 @@
+"""Industrial PS remainder (VERDICT r03 item 6): Downpour-style sparse
+pull/push inside train_from_dataset, mid-train table snapshot/restore,
+and a kill-the-server recovery run. References:
+framework/downpour_worker.cc, operators/distributed/large_scale_kv.h."""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.distributed.ps import PSClient, PSServer
+
+
+VOCAB, DIM = 32, 4
+
+
+@pytest.fixture()
+def server():
+    srv = PSServer(tables={
+        "emb": {"type": "sparse", "dim": DIM, "optimizer": "sgd", "lr": 0.5,
+                "init": "zeros"}})
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+class _IdsDataset:
+    """Minimal dataset yielding {'ids': [b], 'label': [b,1]} batches."""
+
+    def __init__(self, n_batches=12, b=8, seed=0):
+        rng = np.random.RandomState(seed)
+        self._batches = []
+        for _ in range(n_batches):
+            ids = rng.randint(0, VOCAB, (b,)).astype("int64")
+            lab = (ids % 2).astype("float32").reshape(b, 1)
+            self._batches.append({"ids": ids, "label": lab})
+
+    def batches(self):
+        yield from self._batches
+
+
+def _build_program():
+    from paddle_tpu import nn, optimizer
+    paddle.enable_static()
+    main = static.Program("downpour")
+    with static.program_guard(main):
+        ids = static.data("ids", [-1], "int64")
+        label = static.data("label", [-1, 1], "float32")
+        emb = nn.Embedding(VOCAB, DIM)
+        head = nn.Linear(DIM, 1, bias_attr=False)
+        rows = emb(ids)
+        logits = head(rows)
+        loss = paddle.ops.mean(
+            paddle.nn.functional.binary_cross_entropy_with_logits(
+                logits, label))
+        opt = optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss)
+    return main, loss, emb.weight.scope_name, head.weight.scope_name
+
+
+@pytest.fixture(autouse=True)
+def _dynamic_after():
+    yield
+    paddle.disable_static()
+
+
+def test_downpour_train_from_dataset(server):
+    client = PSClient([server.endpoint])
+    main, loss, emb_name, head_name = _build_program()
+    exe = static.Executor()
+    scope = static.global_scope()
+
+    head_before = np.asarray(scope.get(head_name)).copy()
+    ds = _IdsDataset(n_batches=30, b=16)
+    it_losses = []
+    orig_run = exe.run
+
+    def run_and_record(*a, **k):
+        outs = orig_run(*a, **k)
+        if k.get("fetch_list"):
+            it_losses.append(float(np.asarray(outs[0]).mean()))
+        return outs
+
+    exe.run = run_and_record
+    exe.train_from_dataset(
+        program=main, dataset=ds, fetch_list=[loss],
+        ps_config={"client": client,
+                   "sparse": [{"param": emb_name, "slot": "ids",
+                               "table": "emb"}]})
+    exe.run = orig_run
+
+    # the authoritative embedding rows live on the server and must have
+    # trained (server-side sgd accessor applied the pushed grads)
+    ids = np.arange(VOCAB, dtype=np.int64)
+    server_rows = client.pull_sparse("emb", ids)
+    assert np.abs(server_rows).sum() > 0, "server table never updated"
+    # the local optimizer section excluded the PS param but trained head
+    opt_params = [p.name for p, _ in main.optimizer_section[1]]
+    assert emb_name not in opt_params
+    assert not np.allclose(np.asarray(scope.get(head_name)), head_before)
+    # loss goes down over the downpour loop
+    first, last = np.mean(it_losses[:5]), np.mean(it_losses[-5:])
+    assert last < first - 0.02, (first, last)
+    client.close()
+
+
+def test_snapshot_restore_midtrain(server, tmp_path):
+    client = PSClient([server.endpoint])
+    rng = np.random.RandomState(0)
+    ids = np.arange(8, dtype=np.int64)
+    # train the table a bit
+    client.pull_sparse("emb", ids)
+    client.push_sparse_grad("emb", ids, rng.randn(8, DIM).astype("float32"))
+    trained = client.pull_sparse("emb", ids)
+
+    snap = str(tmp_path / "ps_snap")
+    client.save_snapshot(snap)
+    assert os.path.exists(snap + ".s0")
+
+    # keep training past the snapshot, then "fail" and restore
+    client.push_sparse_grad("emb", ids, rng.randn(8, DIM).astype("float32"))
+    after = client.pull_sparse("emb", ids)
+    assert not np.allclose(after, trained)
+    client.load_snapshot(snap)
+    restored = client.pull_sparse("emb", ids)
+    np.testing.assert_allclose(restored, trained, rtol=1e-6)
+    client.close()
+
+
+def test_server_death_and_restart_with_snapshot(tmp_path):
+    """Kill-the-server recovery: state survives via the snapshot file and
+    a fresh server process (the elastic-restart contract; reference
+    heart_beat_monitor.cc + large_scale_kv checkpointing)."""
+    spec = {"emb": {"type": "sparse", "dim": DIM, "optimizer": "sgd",
+                    "lr": 0.5, "init": "zeros"}}
+    srv = PSServer(tables=spec)
+    srv.start()
+    client = PSClient([srv.endpoint])
+    ids = np.arange(6, dtype=np.int64)
+    client.pull_sparse("emb", ids)
+    client.push_sparse_grad("emb", ids,
+                            np.ones((6, DIM), "float32"))
+    trained = client.pull_sparse("emb", ids)
+    snap = str(tmp_path / "snap")
+    client.save_snapshot(snap)
+    client.close()
+    srv.shutdown()          # hard stop — the "failure"
+
+    srv2 = PSServer(tables=spec)
+    srv2.start()
+    c2 = PSClient([srv2.endpoint])
+    assert np.abs(c2.pull_sparse("emb", ids)).sum() == 0  # fresh tables
+    c2.load_snapshot(snap)
+    np.testing.assert_allclose(c2.pull_sparse("emb", ids), trained,
+                               rtol=1e-6)
+    c2.close()
+    srv2.shutdown()
