@@ -130,3 +130,41 @@ def _parse_multislot_py(path, slot_types):
         out.append((np.asarray(per_slot_vals[s], dt),
                     np.asarray(per_slot_splits[s], np.int64)))
     return rows, out
+
+
+# ---- C-ABI predictor library (inference/capi analog) ---------------------
+
+_CAPI_SO = os.path.join(_HERE, "lib", "libpaddle_tpu_capi.so")
+_CAPI_SRC = os.path.join(_HERE, "src", "predictor_capi.c")
+
+
+def _python_embed_flags():
+    import sysconfig
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ver = sysconfig.get_config_var("LDVERSION") or \
+        sysconfig.get_config_var("VERSION")
+    flags = [f"-I{inc}"]
+    if libdir:
+        flags += [f"-L{libdir}", f"-Wl,-rpath,{libdir}"]
+    flags += [f"-lpython{ver}", "-ldl", "-lm"]
+    return flags
+
+
+def build_capi():
+    """Compile libpaddle_tpu_capi.so (embeds CPython over the StableHLO
+    Predictor — see include/paddle_tpu_capi.h). Returns the .so path."""
+    os.makedirs(os.path.dirname(_CAPI_SO), exist_ok=True)
+    if os.path.exists(_CAPI_SO) and \
+            os.path.getmtime(_CAPI_SO) >= os.path.getmtime(_CAPI_SRC):
+        return _CAPI_SO
+    tmp = _CAPI_SO + ".tmp"
+    cmd = ["gcc", "-O2", "-shared", "-fPIC", _CAPI_SRC, "-o", tmp] \
+        + _python_embed_flags()
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, _CAPI_SO)
+    return _CAPI_SO
+
+
+def capi_header():
+    return os.path.join(_HERE, "include", "paddle_tpu_capi.h")

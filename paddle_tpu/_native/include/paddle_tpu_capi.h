@@ -1,0 +1,59 @@
+/* paddle_tpu C-ABI predictor.
+ *
+ * The reference ships C (inference/capi/), Go (go/paddle/predictor.go)
+ * and R clients over its C++ AnalysisPredictor; this header is the
+ * paddle_tpu analog over the StableHLO Predictor. Any language with a C
+ * FFI (Go cgo, R .C, Rust, ...) can drive inference with it.
+ *
+ * Contract:
+ *  - PD_NewPredictor loads a paddle_tpu.jit.save artifact by prefix
+ *    ("model" -> model.stablehlo + model.pdinfer.json). cipher_key_hex
+ *    may be "" or NULL; pass the AES key hex for .enc artifacts.
+ *  - Inputs are caller-owned buffers described by dtype/shape
+ *    (PD_DTYPE_*); they are only read during PD_PredictorRun.
+ *  - Outputs are library-owned f32 buffers, valid until the next
+ *    PD_PredictorRun or PD_DeletePredictor on the same handle.
+ *  - All functions return 0 on success (pointers: non-NULL); on failure
+ *    PD_GetLastError() describes the problem.
+ *  - The library embeds a Python runtime; the first PD_NewPredictor
+ *    initializes it (set PYTHONPATH so paddle_tpu is importable).
+ */
+#ifndef PADDLE_TPU_CAPI_H_
+#define PADDLE_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Predictor PD_Predictor;
+
+enum {
+  PD_DTYPE_FLOAT32 = 0,
+  PD_DTYPE_INT32 = 1,
+  PD_DTYPE_INT64 = 2,
+};
+
+PD_Predictor* PD_NewPredictor(const char* model_prefix,
+                              const char* cipher_key_hex);
+void PD_DeletePredictor(PD_Predictor* predictor);
+
+/* Run inference: n_in caller buffers, each with dtype code, rank
+ * in_ndims[i] and dims in_shapes[i][0..ndim). Returns 0 on success. */
+int PD_PredictorRun(PD_Predictor* predictor, const void* const* in_bufs,
+                    const int* in_dtypes, const int64_t* const* in_shapes,
+                    const int* in_ndims, int n_in);
+
+int PD_PredictorNumOutputs(PD_Predictor* predictor);
+/* Borrowed pointers into library-owned storage for output i. */
+int PD_PredictorOutput(PD_Predictor* predictor, int i, const float** data,
+                       const int64_t** shape, int* ndim);
+
+const char* PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H_ */

@@ -1,0 +1,38 @@
+"""Python side of the C-ABI predictor (reference
+paddle/fluid/inference/capi/ pd_predictor.cc; go/paddle/predictor.go and
+r/ bind the same C surface).
+
+The C library (_native/src/predictor_capi.c) embeds CPython and calls the
+two functions here. Inputs arrive as raw memoryviews over the caller's C
+buffers (zero-copy into numpy); outputs are returned as contiguous f32
+bytes + shapes for the C side to hand out.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+_DTYPES = {0: np.float32, 1: np.int32, 2: np.int64}
+
+
+def create(prefix: str, cipher_key_hex: str = ""):
+    from . import Config, Predictor
+    cfg = Config(prefix)
+    if cipher_key_hex:
+        cfg.set_cipher_key(bytes.fromhex(cipher_key_hex))
+    return Predictor(cfg)
+
+
+def run(predictor, inputs):
+    """inputs: list of (memoryview, dtype_code, shape_tuple). Returns list
+    of (f32_bytes, shape_tuple)."""
+    args = []
+    for mv, code, shape in inputs:
+        arr = np.frombuffer(mv, dtype=_DTYPES[int(code)]).reshape(
+            tuple(int(s) for s in shape))
+        args.append(arr)
+    outs = predictor.run(args)
+    packed = []
+    for o in outs:
+        a = np.ascontiguousarray(np.asarray(o, np.float32))
+        packed.append((a.tobytes(), tuple(int(s) for s in a.shape)))
+    return packed
