@@ -135,7 +135,8 @@ def _parse_multislot_py(path, slot_types):
 # ---- C-ABI predictor library (inference/capi analog) ---------------------
 
 _CAPI_SO = os.path.join(_HERE, "lib", "libpaddle_tpu_capi.so")
-_CAPI_SRC = os.path.join(_HERE, "src", "predictor_capi.c")
+_CAPI_SRCS = [os.path.join(_HERE, "src", "predictor_capi.c"),
+              os.path.join(_HERE, "src", "train_capi.c")]
 
 
 def _python_embed_flags():
@@ -155,11 +156,12 @@ def build_capi():
     """Compile libpaddle_tpu_capi.so (embeds CPython over the StableHLO
     Predictor — see include/paddle_tpu_capi.h). Returns the .so path."""
     os.makedirs(os.path.dirname(_CAPI_SO), exist_ok=True)
-    if os.path.exists(_CAPI_SO) and \
-            os.path.getmtime(_CAPI_SO) >= os.path.getmtime(_CAPI_SRC):
+    if os.path.exists(_CAPI_SO) and all(
+            os.path.getmtime(_CAPI_SO) >= os.path.getmtime(src)
+            for src in _CAPI_SRCS):
         return _CAPI_SO
     tmp = _CAPI_SO + ".tmp"
-    cmd = ["gcc", "-O2", "-shared", "-fPIC", _CAPI_SRC, "-o", tmp] \
+    cmd = ["gcc", "-O2", "-shared", "-fPIC", *_CAPI_SRCS, "-o", tmp] \
         + _python_embed_flags()
     subprocess.run(cmd, check=True, capture_output=True)
     os.replace(tmp, _CAPI_SO)

@@ -52,6 +52,23 @@ int PD_PredictorOutput(PD_Predictor* predictor, int i, const float** data,
 
 const char* PD_GetLastError(void);
 
+/* ---- C train API (reference train/demo C++ training; N33) -------------
+ * A trainer loads an artifact written by
+ * paddle_tpu.static.capi_train.save_train_program (full training Program
+ * + parameter snapshot) and steps it with caller-fed batches. */
+
+typedef struct PD_Trainer PD_Trainer;
+
+PD_Trainer* PD_NewTrainer(const char* artifact_path);
+void PD_DeleteTrainer(PD_Trainer* trainer);
+/* Feeds follow the program's data-var order; *loss receives the step's
+ * loss mean. Returns 0 on success. */
+int PD_TrainerRunStep(PD_Trainer* trainer, const void* const* in_bufs,
+                      const int* in_dtypes,
+                      const int64_t* const* in_shapes, const int* in_ndims,
+                      int n_in, float* loss);
+int PD_TrainerSave(PD_Trainer* trainer, const char* params_path);
+
 #ifdef __cplusplus
 }
 #endif

@@ -31,23 +31,23 @@ struct PD_Predictor {
 
 const char* PD_GetLastError(void) { return pd_err; }
 
-static void pd_set_err(const char* msg) {
+void pd_capi_set_err(const char* msg) {
   snprintf(pd_err, sizeof pd_err, "%s", msg);
 }
 
-static void pd_set_err_from_py(void) {
+void pd_capi_set_err_from_py(void) {
   PyObject *t = NULL, *v = NULL, *tb = NULL;
   PyErr_Fetch(&t, &v, &tb);
   PyObject* s = v ? PyObject_Str(v) : NULL;
   const char* c = s ? PyUnicode_AsUTF8(s) : NULL;
-  pd_set_err(c ? c : "unknown python error");
+  pd_capi_set_err(c ? c : "unknown python error");
   Py_XDECREF(s);
   Py_XDECREF(t);
   Py_XDECREF(v);
   Py_XDECREF(tb);
 }
 
-static int pd_ensure_python(void) {
+int pd_capi_ensure_python(void) {
   if (!Py_IsInitialized()) {
     Py_InitializeEx(0);
     /* release the GIL acquired by initialization so PyGILState_Ensure
@@ -59,19 +59,19 @@ static int pd_ensure_python(void) {
 
 PD_Predictor* PD_NewPredictor(const char* model_prefix,
                               const char* cipher_key_hex) {
-  pd_ensure_python();
+  pd_capi_ensure_python();
   PyGILState_STATE g = PyGILState_Ensure();
   PD_Predictor* h = NULL;
   PyObject *mod = NULL, *pred = NULL;
   mod = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
   if (!mod) {
-    pd_set_err_from_py();
+    pd_capi_set_err_from_py();
     goto done;
   }
   pred = PyObject_CallMethod(mod, "create", "ss", model_prefix,
                              cipher_key_hex ? cipher_key_hex : "");
   if (!pred) {
-    pd_set_err_from_py();
+    pd_capi_set_err_from_py();
     goto done;
   }
   h = (PD_Predictor*)calloc(1, sizeof(PD_Predictor));
@@ -120,7 +120,7 @@ int PD_PredictorRun(PD_Predictor* h, const void* const* in_bufs,
                     const int* in_dtypes, const int64_t* const* in_shapes,
                     const int* in_ndims, int n_in) {
   if (!h || !h->pred) {
-    pd_set_err("null predictor");
+    pd_capi_set_err("null predictor");
     return 1;
   }
   PyGILState_STATE g = PyGILState_Ensure();
@@ -138,7 +138,7 @@ int PD_PredictorRun(PD_Predictor* h, const void* const* in_bufs,
     Py_ssize_t itemsize = pd_dtype_size(in_dtypes[i]);
     if (itemsize == 0) {
       Py_DECREF(shape);
-      pd_set_err("bad input dtype code");
+      pd_capi_set_err("bad input dtype code");
       goto done;
     }
     PyObject* mv = PyMemoryView_FromMemory((char*)in_bufs[i],
@@ -151,12 +151,12 @@ int PD_PredictorRun(PD_Predictor* h, const void* const* in_bufs,
   }
   mod = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
   if (!mod) {
-    pd_set_err_from_py();
+    pd_capi_set_err_from_py();
     goto done;
   }
   outs = PyObject_CallMethod(mod, "run", "OO", h->pred, inputs);
   if (!outs) {
-    pd_set_err_from_py();
+    pd_capi_set_err_from_py();
     goto done;
   }
   h->n_out = (int)PyList_Size(outs);
@@ -198,7 +198,7 @@ int PD_PredictorNumOutputs(PD_Predictor* h) {
 int PD_PredictorOutput(PD_Predictor* h, int i, const float** data,
                        const int64_t** shape, int* ndim) {
   if (!h || !h->last_outputs || i < 0 || i >= h->n_out) {
-    pd_set_err("no such output (run first?)");
+    pd_capi_set_err("no such output (run first?)");
     return 1;
   }
   PyGILState_STATE g = PyGILState_Ensure();

@@ -1,0 +1,130 @@
+/* C-ABI trainer over the static Executor (reference train/demo/
+ * demo_trainer.cc + fluid_train C++ API, N33): load a saved training
+ * Program, step it with caller-fed batches, persist parameters — from
+ * any C host, no Python authoring at train time. Same embed pattern as
+ * predictor_capi.c; both objects link into libpaddle_tpu_capi.so.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "../include/paddle_tpu_capi.h"
+
+/* shared with predictor_capi.c */
+extern const char* PD_GetLastError(void);
+void pd_capi_set_err(const char* msg);
+void pd_capi_set_err_from_py(void);
+int pd_capi_ensure_python(void);
+
+typedef struct PD_Trainer {
+  PyObject* handle;
+} PD_Trainer;
+
+PD_Trainer* PD_NewTrainer(const char* artifact_path) {
+  pd_capi_ensure_python();
+  PyGILState_STATE g = PyGILState_Ensure();
+  PD_Trainer* t = NULL;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.static.capi_train");
+  if (!mod) {
+    pd_capi_set_err_from_py();
+    goto done;
+  }
+  PyObject* h = PyObject_CallMethod(mod, "create", "s", artifact_path);
+  if (!h) {
+    pd_capi_set_err_from_py();
+    Py_DECREF(mod);
+    goto done;
+  }
+  t = (PD_Trainer*)calloc(1, sizeof(PD_Trainer));
+  t->handle = h;
+  Py_DECREF(mod);
+done:
+  PyGILState_Release(g);
+  return t;
+}
+
+void PD_DeleteTrainer(PD_Trainer* t) {
+  if (!t) return;
+  PyGILState_STATE g = PyGILState_Ensure();
+  Py_XDECREF(t->handle);
+  PyGILState_Release(g);
+  free(t);
+}
+
+/* One training step: feeds in the program's feed-name order. The loss
+ * (first backward target) mean is written to *loss. Returns 0 on ok. */
+int PD_TrainerRunStep(PD_Trainer* t, const void* const* in_bufs,
+                      const int* in_dtypes,
+                      const int64_t* const* in_shapes, const int* in_ndims,
+                      int n_in, float* loss) {
+  if (!t || !t->handle) {
+    pd_capi_set_err("null trainer");
+    return 1;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = 1;
+  PyObject *mod = NULL, *inputs = NULL, *res = NULL;
+  inputs = PyList_New(n_in);
+  for (int i = 0; i < n_in; i++) {
+    Py_ssize_t numel = 1;
+    PyObject* shape = PyTuple_New(in_ndims[i]);
+    for (int d = 0; d < in_ndims[i]; d++) {
+      numel *= (Py_ssize_t)in_shapes[i][d];
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(in_shapes[i][d]));
+    }
+    Py_ssize_t itemsize = in_dtypes[i] == PD_DTYPE_INT64 ? 8 : 4;
+    PyObject* mv = PyMemoryView_FromMemory((char*)in_bufs[i],
+                                           numel * itemsize, PyBUF_READ);
+    PyObject* item = PyTuple_Pack(3, mv, PyLong_FromLong(in_dtypes[i]),
+                                  shape);
+    Py_DECREF(mv);
+    Py_DECREF(shape);
+    PyList_SET_ITEM(inputs, i, item);
+  }
+  mod = PyImport_ImportModule("paddle_tpu.static.capi_train");
+  if (!mod) {
+    pd_capi_set_err_from_py();
+    goto done;
+  }
+  res = PyObject_CallMethod(mod, "run_step", "OO", t->handle, inputs);
+  if (!res) {
+    pd_capi_set_err_from_py();
+    goto done;
+  }
+  *loss = (float)PyFloat_AsDouble(res);
+  rc = 0;
+done:
+  Py_XDECREF(mod);
+  Py_XDECREF(inputs);
+  Py_XDECREF(res);
+  PyGILState_Release(g);
+  return rc;
+}
+
+int PD_TrainerSave(PD_Trainer* t, const char* path) {
+  if (!t || !t->handle) {
+    pd_capi_set_err("null trainer");
+    return 1;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  int rc = 1;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.static.capi_train");
+  if (mod) {
+    PyObject* res =
+        PyObject_CallMethod(mod, "save_params", "Os", t->handle, path);
+    if (res) {
+      rc = 0;
+      Py_DECREF(res);
+    } else {
+      pd_capi_set_err_from_py();
+    }
+    Py_DECREF(mod);
+  } else {
+    pd_capi_set_err_from_py();
+  }
+  PyGILState_Release(g);
+  return rc;
+}

@@ -1,0 +1,80 @@
+"""Python side of the C-ABI trainer (reference `train/demo/demo_trainer.cc`
+— the C++ train API: load a saved ProgramDesc, run startup then step the
+main program with an Executor; N33 in SURVEY §2.1).
+
+Artifact format (`save_train_program`): one pickle holding the full
+training Program (forward + backward + optimizer sections) and a snapshot
+of its persistable scope values, so a C host can resume training without
+any Python authoring step.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+__all__ = ["save_train_program", "create", "run_step", "save_params"]
+
+_DTYPES = {0: np.float32, 1: np.int32, 2: np.int64}
+
+
+def save_train_program(program, path, scope=None):
+    """Persist a TRAINING program (unpruned: backward + optimizer sections
+    ride along) plus current persistable values."""
+    from .program import global_scope
+    scope = scope or global_scope()
+    state = {}
+    for name in program.persistable_vars:
+        if scope.has(name):
+            state[name] = np.asarray(scope.get(name))
+    with open(path, "wb") as f:
+        pickle.dump({"program": program, "state": state}, f, protocol=4)
+    return path
+
+
+def create(path):
+    """Load a train artifact into a fresh (program, executor, scope)."""
+    from .executor import Executor
+    from .program import Scope
+    with open(path, "rb") as f:
+        payload = pickle.load(f)  # noqa: S301 — local artifact
+    program = payload["program"]
+    scope = Scope()
+    import jax.numpy as jnp
+    for name, val in payload["state"].items():
+        scope.set(name, jnp.asarray(val))
+    return {"program": program, "exe": Executor(), "scope": scope,
+            "feed_names": list(program.data_vars)}
+
+
+def feed_names(handle):
+    return list(handle["feed_names"])
+
+
+def run_step(handle, inputs, fetch_name=None):
+    """inputs: list of (memoryview, dtype_code, shape) in feed_names
+    order. Returns the mean of the first fetch (the loss) as float."""
+    feed = {}
+    for name, (mv, code, shape) in zip(handle["feed_names"], inputs):
+        feed[name] = np.frombuffer(mv, dtype=_DTYPES[int(code)]).reshape(
+            tuple(int(s) for s in shape))
+    program = handle["program"]
+    if fetch_name:
+        fetch = [fetch_name]
+    else:
+        bw = getattr(program, "backward_section", None)
+        if bw is None:
+            raise ValueError("train program has no backward section")
+        fetch = [bw[0]]
+    outs = handle["exe"].run(program, feed=feed, fetch_list=fetch,
+                             scope=handle["scope"])
+    return float(np.asarray(outs[0]).mean())
+
+
+def save_params(handle, path):
+    state = {n: np.asarray(handle["scope"].get(n))
+             for n in handle["program"].persistable_vars
+             if handle["scope"].has(n)}
+    from ..framework.io import save as _save
+    _save(state, path if path.endswith(".pdparams") else path + ".pdparams")
+    return path
