@@ -1,0 +1,90 @@
+"""Round-4 vision dataset breadth (reference vision/datasets: folder.py,
+flowers.py, voc2012.py) — built against synthetic archives so the tests
+run zero-egress."""
+import os
+import tarfile
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from paddle_tpu.vision.datasets import (DatasetFolder, Flowers, ImageFolder,
+                                        VOC2012)
+
+
+def _png(path, color, size=(8, 6)):
+    Image.new("RGB", size, color).save(path)
+
+
+def test_dataset_folder(tmp_path):
+    for cls, color in (("cat", (255, 0, 0)), ("dog", (0, 255, 0))):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            _png(str(d / f"{i}.png"), color)
+    ds = DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (6, 8, 3) and label == 0
+    img, label = ds[5]
+    assert label == 1 and img[0, 0, 1] == 255
+
+    flat = ImageFolder(str(tmp_path))
+    assert len(flat) == 6
+    (img,) = flat[0]
+    assert img.shape == (6, 8, 3)
+
+    # transform hook
+    ds2 = DatasetFolder(str(tmp_path), transform=lambda a: a.mean())
+    v, _ = ds2[0]
+    assert np.isscalar(v) or np.ndim(v) == 0
+
+
+def test_flowers(tmp_path):
+    import scipy.io
+    tgz = str(tmp_path / "102flowers.tgz")
+    with tarfile.open(tgz, "w:gz") as tf:
+        for i in range(1, 5):
+            p = str(tmp_path / f"image_{i:05d}.jpg")
+            Image.new("RGB", (10, 10), (i * 20, 0, 0)).save(p)
+            tf.add(p, arcname=f"jpg/image_{i:05d}.jpg")
+    labels = str(tmp_path / "imagelabels.mat")
+    scipy.io.savemat(labels, {"labels": np.array([[1, 2, 1, 2]])})
+    setid = str(tmp_path / "setid.mat")
+    scipy.io.savemat(setid, {"trnid": np.array([[1, 3]]),
+                             "valid": np.array([[2]]),
+                             "tstid": np.array([[4]])})
+    ds = Flowers(data_file=tgz, label_file=labels, setid_file=setid,
+                 mode="train")
+    assert len(ds) == 2
+    img, lab = ds[0]
+    assert img.shape == (10, 10, 3) and int(lab[0]) == 1
+    assert len(Flowers(data_file=tgz, label_file=labels,
+                       setid_file=setid, mode="valid")) == 1
+    with pytest.raises(RuntimeError):
+        Flowers(download=True)
+
+
+def test_voc2012(tmp_path):
+    tar_path = str(tmp_path / "voc.tar")
+    keys = ["2007_000001", "2007_000002"]
+    with tarfile.open(tar_path, "w") as tf:
+        lst = str(tmp_path / "train.txt")
+        with open(lst, "w") as f:
+            f.write("\n".join(keys) + "\n")
+        tf.add(lst, arcname="VOCdevkit/VOC2012/ImageSets/Segmentation/"
+               "train.txt")
+        for k in keys:
+            jp = str(tmp_path / f"{k}.jpg")
+            Image.new("RGB", (12, 9), (1, 2, 3)).save(jp)
+            tf.add(jp, arcname=f"VOCdevkit/VOC2012/JPEGImages/{k}.jpg")
+            pp = str(tmp_path / f"{k}.png")
+            Image.new("P", (12, 9), 0).save(pp)
+            tf.add(pp, arcname="VOCdevkit/VOC2012/SegmentationClass/"
+                   f"{k}.png")
+    ds = VOC2012(data_file=tar_path, mode="train")
+    assert len(ds) == 2
+    img, lab = ds[0]
+    assert img.shape == (9, 12, 3)
+    assert lab.shape == (9, 12)
