@@ -93,7 +93,7 @@ class Node:
     """One recorded op: holds the vjp closure and edges to differentiable inputs."""
 
     __slots__ = ("vjp_fn", "inputs", "out_avals", "seq", "name", "multi_out",
-                 "__weakref__")
+                 "out_hooks", "__weakref__")
 
     def __init__(self, vjp_fn, inputs, out_avals, name, multi_out):
         self.vjp_fn = vjp_fn
@@ -101,6 +101,7 @@ class Node:
         self.out_avals = out_avals    # list[(shape, dtype)]
         self.name = name
         self.multi_out = multi_out
+        self.out_hooks = None         # {out_index: [hook]} via register_hook
         with _seq_lock:
             _seq_counter[0] += 1
             self.seq = _seq_counter[0]
@@ -331,6 +332,18 @@ def _run_engine(seeds, accumulate_leaf=True, capture=None, retain_graph=False):
             continue
         full = [c if c is not None else _zero_cot(*n.out_avals[i])
                 for i, c in enumerate(outs_cot)]
+        if n.out_hooks:
+            # Tensor.register_hook: fires with the tensor's accumulated
+            # grad; a non-None return REPLACES the grad flowing upstream
+            # (reference imperative/hooks.h GradAccumulatorPostHook)
+            from .tensor import Tensor
+            for i, hooks in n.out_hooks.items():
+                for h in hooks:
+                    res = h(Tensor(full[i], stop_gradient=True,
+                                   _internal=True))
+                    if res is not None:
+                        full[i] = res._value if isinstance(res, Tensor) \
+                            else res
         if n.vjp_fn is None:
             raise RuntimeError(
                 f"grad graph for op '{n.name}' was already freed; "
@@ -393,6 +406,10 @@ def backward(tensor, grad_tensor=None, retain_graph=False):
             leaf = tensor
         if leaf is None:
             continue
+        for h in (getattr(leaf, "_leaf_hooks", None) or ()):
+            res = h(Tensor(gval, stop_gradient=True, _internal=True))
+            if res is not None:
+                gval = res._value if isinstance(res, Tensor) else res
         if leaf.grad is None:
             leaf.grad = Tensor(gval, stop_gradient=True, _internal=True)
         else:

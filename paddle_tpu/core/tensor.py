@@ -45,7 +45,8 @@ def _coerce(value, dtype=None):
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "name", "persistable",
-                 "trainable", "_node", "_out_index", "__weakref__")
+                 "trainable", "_node", "_out_index", "_leaf_hooks",
+                 "__weakref__")
 
     def __init__(self, value, dtype=None, stop_gradient=True, name=None,
                  persistable=False, _internal=False):
@@ -161,7 +162,33 @@ class Tensor:
         return ops.assign(self)
 
     def register_hook(self, hook):
-        raise NotImplementedError("tensor hooks: planned (imperative/hooks.h parity)")
+        """Register a gradient hook (reference imperative/hooks.h via
+        varbase_patch_methods register_hook): `hook(grad) -> new_grad |
+        None`, fired when this tensor's gradient is computed during
+        backward. Returns a RemovableHandle."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "cannot register a gradient hook on a tensor with "
+                "stop_gradient=True")
+        if self._node is not None:
+            hooks = self._node.out_hooks
+            if hooks is None:
+                hooks = self._node.out_hooks = {}
+            lst = hooks.setdefault(self._out_index, [])
+        else:
+            if getattr(self, "_leaf_hooks", None) is None:
+                self._leaf_hooks = []
+            lst = self._leaf_hooks
+        lst.append(hook)
+
+        class _Handle:
+            def remove(_self):
+                try:
+                    lst.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Handle()
 
     # -- mutation (rebinds value; autograd-safe SSA rebind) -----------------
     def set_value(self, value):

@@ -1,0 +1,55 @@
+"""Tensor.register_hook (reference imperative/hooks.h +
+varbase_patch_methods.py register_hook) — grad observation and
+replacement on intermediate and leaf tensors."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_hook_observes_and_replaces_grad():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"),
+                         stop_gradient=False)
+    y = x * 2.0
+    seen = []
+    y.register_hook(lambda g: seen.append(np.asarray(g._value))
+                    or (g * 10.0))
+    y.sum().backward()
+    np.testing.assert_allclose(seen[0], [1.0, 1.0])
+    np.testing.assert_allclose(np.asarray(x.grad._value), [20.0, 20.0])
+
+
+def test_leaf_hook_and_remove():
+    x = paddle.to_tensor(np.ones(3, "float32"), stop_gradient=False)
+    seen = []
+    h = x.register_hook(lambda g: seen.append(1))
+    (x * 3.0).sum().backward()
+    assert seen == [1]
+    h.remove()
+    x.clear_gradient()
+    (x * 3.0).sum().backward()
+    assert seen == [1]          # removed hook does not fire again
+
+
+def test_observer_hook_keeps_grad():
+    x = paddle.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+    y = x * 5.0
+    y.register_hook(lambda g: None)     # pure observer
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), [5.0, 5.0])
+
+
+def test_hook_on_stop_gradient_raises():
+    x = paddle.to_tensor(np.ones(2, "float32"))
+    with pytest.raises(RuntimeError):
+        x.register_hook(lambda g: g)
+
+
+def test_multiple_hooks_chain_in_order():
+    x = paddle.to_tensor(np.ones(2, "float32"), stop_gradient=False)
+    y = x * 1.0
+    y.register_hook(lambda g: g + 1.0)
+    y.register_hook(lambda g: g * 2.0)
+    y.sum().backward()
+    # (1 + 1) * 2 = 4
+    np.testing.assert_allclose(np.asarray(x.grad._value), [4.0, 4.0])
