@@ -517,3 +517,92 @@ def shuffle_batch(x, seed=0):
     xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
     perm = np.random.RandomState(seed).permutation(xv.shape[0])
     return Tensor(xv[jnp.asarray(perm)], _internal=True)
+
+
+@defop
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    """Deformable convolution v1/v2 (reference
+    operators/deformable_conv_op.cc / deformable_conv_v1_op.cc): each
+    kernel tap samples the input at a learned fractional offset
+    (bilinear), v2 additionally modulates each tap by `mask`.
+
+    x [n, ci, h, w]; offset [n, 2*dg*kh*kw, oh, ow] with (y, x) pairs per
+    tap; mask [n, dg*kh*kw, oh, ow] or None; weight [co, ci/groups, kh,
+    kw]. Vectorized over space — the K tap loop is static so XLA fuses
+    each tap's gather+lerp into the final contraction."""
+    s = _pair(stride)
+    p = _pair(padding)
+    d = _pair(dilation)
+    n, ci, h, w = x.shape
+    co, _, kh, kw = weight.shape
+    oh = (h + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+    ow = (w + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+    K = kh * kw
+    dg = int(deformable_groups)
+    cg = ci // dg                                    # channels per dg
+
+    off = jnp.reshape(offset.astype(jnp.float32), (n, dg, K, 2, oh, ow))
+    if mask is not None:
+        m = jnp.reshape(mask.astype(jnp.float32), (n, dg, K, oh, ow))
+
+    oy = jnp.arange(oh, dtype=jnp.float32)[:, None] * s[0] - p[0]
+    ox = jnp.arange(ow, dtype=jnp.float32)[None, :] * s[1] - p[1]
+
+    def bilinear(img, py, px):
+        """img [n, dg, cg, h, w]; py/px [n, dg, oh, ow] -> samples
+        [n, dg, cg, oh, ow]; out-of-bounds reads 0."""
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy = (py - y0)[:, :, None]
+        wx = (px - x0)[:, :, None]
+
+        def tap(yy, xx):
+            inb = ((yy >= 0) & (yy < h) & (xx >= 0)
+                   & (xx < w))[:, :, None].astype(img.dtype)
+            cy = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            cx = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            g = jax.vmap(jax.vmap(               # over n, then dg
+                lambda im, a, b: im[:, a, b]))(img, cy, cx)
+            return g * inb
+
+        v00 = tap(y0, x0)
+        v01 = tap(y0, x0 + 1)
+        v10 = tap(y0 + 1, x0)
+        v11 = tap(y0 + 1, x0 + 1)
+        wy = wy.astype(img.dtype)
+        wx = wx.astype(img.dtype)
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    xg = jnp.reshape(x, (n, dg, cg, h, w))
+    cols = []
+    for k in range(K):
+        ky, kx = k // kw, k % kw
+        py = oy[None, None] + ky * d[0] + off[:, :, k, 0]   # [n, dg, oh, ow]
+        px = ox[None, None] + kx * d[1] + off[:, :, k, 1]
+        smp = bilinear(xg, py, px)                   # [n, dg, cg, oh, ow]
+        if mask is not None:
+            smp = smp * m[:, :, k][:, :, None].astype(smp.dtype)
+        cols.append(smp)
+    col = jnp.stack(cols, axis=3)                    # [n, dg, cg, K, oh, ow]
+    col = jnp.reshape(col, (n, ci, K, oh, ow))
+
+    gci = ci // groups
+    gco = co // groups
+    colg = jnp.reshape(col, (n, groups, gci, K, oh, ow))
+    wg = jnp.reshape(weight, (groups, gco, gci, kh * kw))
+    out = jnp.einsum("ngckhw,gock->ngohw", colg, wg,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    out = jnp.reshape(out, (n, co, oh, ow))
+    if bias is not None:
+        out = out + jnp.reshape(bias, (1, -1, 1, 1))
+    return out
+
+
+def deformable_conv(x, offset, mask, weight, bias=None, stride=1,
+                    padding=0, dilation=1, deformable_groups=1, groups=1,
+                    im2col_step=None):
+    """reference v1 op name (mask=None) / v2 (modulated)."""
+    return deform_conv2d(x, offset, weight, bias, stride, padding,
+                         dilation, deformable_groups, groups, mask)

@@ -524,3 +524,19 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
     if normalizer is not None:
         loss = loss / normalizer
     return loss
+
+
+# reference v1 op names for the same kernels (op_registry.h registers
+# these exact strings; keep them resolvable in the inventory)
+def kldiv_loss(x, target, reduction="mean"):
+    return kl_div(x, target, reduction=reduction)
+
+
+def bce_loss(input, label):  # noqa: A002
+    return binary_cross_entropy(input, label, reduction="none")
+
+
+def warpctc(logits, label, logits_length=None, labels_length=None,
+            blank=0, norm_by_times=False):
+    return ctc_loss(logits, label, logits_length, labels_length,
+                    blank=blank)

@@ -591,3 +591,27 @@ def partial_sum(xs, start_index=0, length=-1):
         end = t.shape[1] if length == -1 else start_index + length
         parts.append(t[:, start_index:end])
     return sum(parts[1:], parts[0])
+
+
+def pad2d(x, paddings, mode="constant", pad_value=0.0, data_format="NCHW"):
+    """reference pad2d_op.cc — 4-number [top, bottom, left, right] form."""
+    t, b, l, r = (int(p) for p in paddings)
+    return pad(x, [l, r, t, b], mode=mode, value=pad_value,
+               data_format=data_format)
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    """reference pad3d_op.cc — [front, back, top, bottom, left, right]."""
+    f, bk, t, b, l, r = (int(p) for p in paddings)
+    return pad(x, [l, r, t, b, f, bk], mode=mode, value=value,
+               data_format=data_format)
+
+
+@defop
+def set_value(x, value, item=None):
+    """reference set_value_op.cc (tensor slice assignment in static
+    graphs): returns x with `item` (any basic index) replaced by value;
+    whole-tensor assign when item is None."""
+    if item is None:
+        return jnp.broadcast_to(jnp.asarray(value, x.dtype), x.shape)
+    return x.at[item].set(jnp.asarray(value, x.dtype))

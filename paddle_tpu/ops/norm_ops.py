@@ -222,3 +222,8 @@ def l2_normalize(x, axis=-1, epsilon=1e-12):
     """reference norm_op.cc (l2 normalize along axis)."""
     n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True))
     return x / jnp.maximum(n, epsilon)
+
+
+def lrn(x, n=5, k=1.0, alpha=1e-4, beta=0.75, data_format="NCHW"):
+    """reference lrn_op.cc — v1 name for local_response_norm (NCHW)."""
+    return local_response_norm(x, size=n, alpha=alpha, beta=beta, k=k)

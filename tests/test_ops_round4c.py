@@ -1,0 +1,101 @@
+"""Round-4 op widening batch 3: deformable conv, SyncBatchNorm convert,
+set_value, reference-v1 alias names."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops
+
+
+def T(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+def test_deform_conv2d_zero_offsets_equals_conv2d():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 6, 6).astype("float32")
+    w = rng.randn(5, 4, 3, 3).astype("float32")
+    off = np.zeros((2, 2 * 9, 6, 6), "float32")
+    out = ops.deform_conv2d(T(x), T(off), T(w), padding=1)
+    ref = ops.conv2d(T(x), T(w), padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-5)
+
+
+def test_deform_conv2d_integer_offset_shifts_sampling():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 6, 6).astype("float32")
+    w = rng.randn(3, 2, 3, 3).astype("float32")
+    off = np.zeros((1, 18, 6, 6), "float32")
+    off[:, 1::2] = 1.0                       # +1 in x for every tap
+    out = ops.deform_conv2d(T(x), T(off), T(w), padding=1)
+    xs = np.zeros_like(x)
+    xs[..., :-1] = x[..., 1:]
+    ref = ops.conv2d(T(xs), T(w), padding=1)
+    np.testing.assert_allclose(out.numpy()[..., 1:-1, 1:-1],
+                               ref.numpy()[..., 1:-1, 1:-1], atol=1e-5)
+
+
+def test_deform_conv2d_mask_modulates():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    w = rng.randn(2, 2, 3, 3).astype("float32")
+    off = np.zeros((1, 18, 4, 4), "float32")
+    m0 = np.zeros((1, 9, 4, 4), "float32")   # all taps masked -> zeros
+    out = ops.deform_conv2d(T(x), T(off), T(w), padding=1, mask=T(m0))
+    np.testing.assert_allclose(out.numpy(), 0.0, atol=1e-6)
+    m1 = np.ones((1, 9, 4, 4), "float32")
+    out1 = ops.deform_conv2d(T(x), T(off), T(w), padding=1, mask=T(m1))
+    ref = ops.conv2d(T(x), T(w), padding=1)
+    np.testing.assert_allclose(out1.numpy(), ref.numpy(), atol=1e-5)
+
+
+def test_deform_conv2d_differentiable():
+    rng = np.random.RandomState(3)
+    x = T(rng.randn(1, 2, 4, 4).astype("float32"))
+    x.stop_gradient = False
+    off = T(rng.randn(1, 18, 4, 4).astype("float32") * 0.3)
+    off.stop_gradient = False
+    w = T(rng.randn(2, 2, 3, 3).astype("float32"))
+    out = ops.deform_conv2d(x, off, w, padding=1)
+    out.sum().backward()
+    assert np.isfinite(np.asarray(x.grad._value)).all()
+    assert np.abs(np.asarray(off.grad._value)).sum() > 0
+
+
+def test_sync_batchnorm_convert_and_global_stats():
+    net = nn.Sequential(nn.Conv2D(3, 4, 3), nn.BatchNorm2D(4))
+    net2 = nn.SyncBatchNorm.convert_sync_batchnorm(net)
+    bns = [m for _, m in net2.named_sublayers()
+           if isinstance(m, nn.SyncBatchNorm)]
+    assert len(bns) == 1
+    # under plain eager (no mesh region) it behaves like BatchNorm
+    x = T(np.random.RandomState(4).randn(2, 3, 8, 8))
+    y = net2(x)
+    assert np.isfinite(y.numpy()).all()
+
+
+def test_set_value_and_alias_names():
+    x = T(np.zeros((3, 4)))
+    out = ops.set_value(x, 7.0)
+    assert (out.numpy() == 7).all()
+    out = ops.set_value(x, 5.0, item=(slice(0, 2), slice(1, 3)))
+    assert out.numpy()[0, 1] == 5 and out.numpy()[2, 3] == 0
+    from paddle_tpu.ops._dispatch import OP_REGISTRY
+    for name in ("kldiv_loss", "bce_loss", "warpctc", "lrn", "pad2d",
+                 "pad3d", "set_value", "deform_conv2d", "deformable_conv"):
+        assert name in OP_REGISTRY, name
+    # alias correctness spot-check
+    a = T(np.random.RandomState(5).rand(2, 3) + 0.1)
+    b = T(np.random.RandomState(6).rand(2, 3) + 0.1)
+    np.testing.assert_allclose(ops.lrn(T(np.ones((1, 2, 3, 3)))).numpy(),
+                               ops.local_response_norm(
+                                   T(np.ones((1, 2, 3, 3))), 5).numpy())
+
+
+def test_pad2d_pad3d():
+    x = T(np.ones((1, 1, 2, 2)))
+    out = ops.pad2d(x, [1, 0, 2, 0])         # top=1 left=2
+    assert out.shape == (1, 1, 3, 4)
+    assert out.numpy()[0, 0, 0, 2] == 0 and out.numpy()[0, 0, 1, 2] == 1
+    x3 = T(np.ones((1, 1, 2, 2, 2)))
+    out = ops.pad3d(x3, [1, 1, 0, 0, 0, 0])
+    assert out.shape == (1, 1, 4, 2, 2)
