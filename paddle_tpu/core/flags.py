@@ -70,6 +70,17 @@ define_flag("FLAGS_enable_profiler", False, "enable host event profiler")
 define_flag("FLAGS_use_flash_attention", True,
             "route attention through the Pallas flash kernel on TPU "
             "(paddle_tpu.ops.pallas.flash_attention)")
+define_flag("FLAGS_flash_min_seq", 1024,
+            "dispatch threshold: the Pallas flash-attention kernel engages "
+            "when s_k >= this (long-context regime where O(s^2) score "
+            "materialization dominates); below it XLA's fused attention is "
+            "faster on the MXU at these shapes. 0 forces the kernel on "
+            "whenever shapes allow.")
+define_flag("FLAGS_flash_block_q", 0,
+            "flash attention q block size (0 = auto: 256 for s>=1024 else "
+            "128)")
+define_flag("FLAGS_flash_block_k", 0,
+            "flash attention k block size (0 = auto)")
 define_flag("FLAGS_flash_attention_interpret", False,
             "also use the flash kernel off-TPU via the Pallas interpreter "
             "(slow; for tests)")

@@ -207,10 +207,10 @@ class Communicator:
                     first_ts = None
         except BaseException as e:  # noqa: BLE001 — re-raised to callers
             self._error = e
-            # account for anything we'll never send so flush() raises
-            # instead of timing out
-            for _ in pending:
-                self._q.task_done()
+            # NOTE: _send_merged's finally already task_done'd `pending`;
+            # only drain what's still queued so flush() raises instead of
+            # timing out (double-accounting raises 'task_done called too
+            # many times')
             while True:
                 try:
                     self._q.get_nowait()

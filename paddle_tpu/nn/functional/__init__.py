@@ -119,6 +119,13 @@ def _flash_eligible(query, key, value, attn_mask):
         return False
     if not _pallas_backend_ok("FLAGS_flash_attention_interpret"):
         return False
+    # profitability dispatch (measured on v5e): at short seq XLA's fused
+    # attention wins — per-grid-step overhead dominates the kernel; the
+    # kernel's O(s) memory + blockwise matmuls win in the long-context
+    # regime. FLAGS_flash_min_seq=0 forces the kernel on.
+    min_seq = int(_flags.flag("FLAGS_flash_min_seq"))
+    if min_seq and key.shape[-2] < min_seq:
+        return False
     if attn_mask is not None and isinstance(attn_mask, Tensor) \
             and not attn_mask.stop_gradient:
         # the kernel treats the bias as data (no mask gradient); a learned

@@ -37,10 +37,19 @@ NEG_INF = -1e9  # finite "masked" value: keeps running-max finite even for
                 # fully-padded rows (exp(NEG_INF - NEG_INF) stays sane)
 
 
-def _pick_block(s: int, target: int = 128):
+def _pick_block(s: int, target: int = None, flag: str = None):
     """Largest block size <= target that divides s, no smaller than 8 (the
-    f32 sublane tile); None means "not kernel-friendly, use the jnp path"."""
-    for b in (target, 128, 64, 32, 16, 8):
+    f32 sublane tile); None means "not kernel-friendly, use the jnp path".
+    target=None: FLAGS_flash_block_* override, else auto — 256 once the
+    sequence is long enough to amortize (measured on v5e: s=2048 fwd+dq
+    3.70ms at blk 256 vs 5.41ms at blk 128)."""
+    if target is None:
+        cfg = 0
+        if flag is not None:
+            from ...core import flags as _flags
+            cfg = int(_flags.flag(flag) or 0)
+        target = cfg if cfg else (256 if s >= 1024 else 128)
+    for b in (target, 512, 256, 128, 64, 32, 16, 8):
         if b <= target and s % b == 0:
             return b
     return None
@@ -451,7 +460,8 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
             f"q{tuple(q.shape)} k{tuple(k.shape)} v{tuple(v.shape)}")
     if scale is None:
         scale = d ** -0.5
-    bq, bk = _pick_block(sq), _pick_block(sk)
+    bq = _pick_block(sq, flag="FLAGS_flash_block_q")
+    bk = _pick_block(sk, flag="FLAGS_flash_block_k")
     if bq is None or bk is None:
         raise ValueError(f"flash_attention: seq lengths ({sq},{sk}) have no "
                          "power-of-two block factor; pad to a multiple of 8")

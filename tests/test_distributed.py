@@ -29,7 +29,10 @@ def mesh8():
 
 @pytest.fixture
 def mesh_sp():
-    m = mesh_mod.init_mesh({"sp": 8}, name="default")
+    # sp=4: the ring/ulysses math is degree-independent and the 8-way
+    # form is exercised by the dryrun gate; 4 halves the scan-of-permutes
+    # compile time that dominated the suite profile
+    m = mesh_mod.init_mesh({"sp": 4}, name="default")
     yield m
     mesh_mod.init_mesh({"dp": 8})
 
@@ -340,10 +343,13 @@ def test_pipeline_1f1b_schedule_matches_gpipe():
     numerically identical to gpipe — rematerialization changes memory,
     never math. Swept over microbatch counts."""
     from paddle_tpu.distributed.pipeline import bubble_fraction
-    mesh = mesh_mod.init_mesh({"pp": 8}, name="default")
+    # 4 stages, 2 microbatch counts: full 8-stage coverage lives in the
+    # dryrun_multichip gate; this test's job is ONLY gpipe==1f1b math,
+    # and 6 shard_map compilations at 8 stages cost minutes of suite time
+    mesh = mesh_mod.init_mesh({"pp": 4}, name="default")
     rng = np.random.RandomState(3)
     d = 4
-    ws = rng.randn(8, d, d).astype("float32") * 0.5
+    ws = rng.randn(4, d, d).astype("float32") * 0.5
     x = rng.randn(16, d).astype("float32")
     y = rng.randn(16, d).astype("float32")
 
@@ -368,7 +374,7 @@ def test_pipeline_1f1b_schedule_matches_gpipe():
 
         return jax.value_and_grad(outer)(jnp.asarray(ws))
 
-    for n_micro in (2, 4, 8):  # bubble 0.78 -> 0.64 -> 0.47
+    for n_micro in (2, 8):  # bubble high -> low ends of the sweep
         l0, g0 = run("gpipe", n_micro)
         l1, g1 = run("1f1b", n_micro)
         np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)

@@ -66,7 +66,8 @@ def test_sdpa_routes_through_flash(monkeypatch):
         return real(*a, **kw)
 
     monkeypatch.setattr(F, "_flash_sdpa", counted)
-    paddle.set_flags({"FLAGS_flash_attention_interpret": True})
+    paddle.set_flags({"FLAGS_flash_attention_interpret": True,
+                          "FLAGS_flash_min_seq": 0})
     try:
         rng = np.random.RandomState(1)
         mk = lambda *s: paddle.to_tensor(  # noqa: E731
@@ -79,7 +80,8 @@ def test_sdpa_routes_through_flash(monkeypatch):
         np.testing.assert_allclose(np.asarray(out_flash._value),
                                    np.asarray(out_ref._value), atol=2e-5)
 
-        paddle.set_flags({"FLAGS_flash_attention_interpret": True})
+        paddle.set_flags({"FLAGS_flash_attention_interpret": True,
+                          "FLAGS_flash_min_seq": 0})
         out_flash.sum().backward()
         gq = np.asarray(q.grad._value)
         assert np.isfinite(gq).all() and np.abs(gq).max() > 0
@@ -90,7 +92,8 @@ def test_sdpa_routes_through_flash(monkeypatch):
 def test_mha_layer_uses_flash_and_trains():
     """MultiHeadAttention forward/backward through the kernel, bf16-safe."""
     from paddle_tpu import nn
-    paddle.set_flags({"FLAGS_flash_attention_interpret": True})
+    paddle.set_flags({"FLAGS_flash_attention_interpret": True,
+                          "FLAGS_flash_min_seq": 0})
     try:
         paddle.seed(0)
         mha = nn.MultiHeadAttention(32, 2, dropout=0.0)
@@ -134,7 +137,8 @@ def test_flash_fallbacks():
     # odd sequence length: no block factor
     assert not supported((1, 2, 33, 16), (1, 2, 33, 16), (1, 2, 33, 16))
     # the functional API still works on those shapes (fallback path)
-    paddle.set_flags({"FLAGS_flash_attention_interpret": True})
+    paddle.set_flags({"FLAGS_flash_attention_interpret": True,
+                          "FLAGS_flash_min_seq": 0})
     try:
         rng = np.random.RandomState(4)
         mk = lambda *s: paddle.to_tensor(  # noqa: E731
