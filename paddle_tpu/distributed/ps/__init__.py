@@ -22,13 +22,14 @@ from __future__ import annotations
 import numpy as np
 
 from .client import Communicator, PSClient
+from .heter import DeviceHashTable, HeterPSCache
 from .server import PSServer
 from .table import (BarrierTable, DenseTable, GeoSparseTable, SparseTable,
                     make_table)
 
 __all__ = ["PSServer", "PSClient", "Communicator", "DenseTable",
            "SparseTable", "GeoSparseTable", "BarrierTable", "make_table",
-           "SparseEmbedding"]
+           "SparseEmbedding", "DeviceHashTable", "HeterPSCache"]
 
 
 class SparseEmbedding:

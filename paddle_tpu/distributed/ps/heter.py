@@ -1,0 +1,163 @@
+"""HeterPS — accelerator-resident embedding cache over the host PS.
+
+Reference tier: framework/fleet/heter_ps/hashtable.h + heter_comm.h (a
+GPU-resident concurrent hashtable caching hot embedding rows, backed by
+the CPU parameter server). TPU redesign: the table is a pair of jnp
+arrays (open-addressing keys [cap] i64 + values [cap, dim]) living in
+HBM, with LOOKUP as a fully vectorized fixed-probe gather that jits into
+the training step, and INSERT as a lax.fori_loop of dynamic updates (runs
+once per batch on the miss set, off the hot path). No device hashtable
+kernels to hand-write — XLA lowers both to gathers/scatters.
+
+Semantics: read-through cache with push-through writes —
+  rows = cache.pull(ids)        # device hits + host PS misses
+  ...                           # grads computed on device
+  cache.push_grad(ids, grads)   # goes to the PS (server accessor owns
+                                # the update rule), cached copies refresh
+so the server stays authoritative (same division of labor as the
+reference: hashtable.h caches, the DownpourPsClient owns optimizer state).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DeviceHashTable", "HeterPSCache"]
+
+_EMPTY = np.int64(-1)
+
+
+def _mix(h):
+    """splitmix64 finalizer — good avalanche for sequential ids."""
+    import jax.numpy as jnp
+    h = (h ^ (h >> 30)) * jnp.int64(-4658895280553007687)   # 0xbf58476d1ce4e5b9
+    h = (h ^ (h >> 27)) * jnp.int64(-7723592293110705685)   # 0x94d049bb133111eb
+    return h ^ (h >> 31)
+
+
+class DeviceHashTable:
+    """Fixed-capacity open-addressing (linear probe) id -> row table as a
+    functional pytree of device arrays."""
+
+    def __init__(self, capacity, dim, max_probes=16, dtype="float32"):
+        import jax.numpy as jnp
+        self.capacity = int(capacity)
+        self.dim = int(dim)
+        self.max_probes = int(max_probes)
+        self.keys = jnp.full((self.capacity,), _EMPTY, jnp.int64)
+        self.values = jnp.zeros((self.capacity, self.dim), dtype)
+        self._count = 0
+
+    # ---- pure kernels ----------------------------------------------------
+    def _slots(self, ids):
+        """[n, max_probes] candidate slots per query id."""
+        import jax.numpy as jnp
+        h = _mix(ids.astype(jnp.int64)) % self.capacity
+        probe = jnp.arange(self.max_probes, dtype=jnp.int64)
+        return (h[:, None] + probe[None, :]) % self.capacity
+
+    def lookup(self, ids):
+        """ids [n] -> (rows [n, dim], found [n] bool). Jit-safe: static
+        shapes, no host sync; missing ids read zeros."""
+        import jax.numpy as jnp
+        ids = jnp.asarray(ids, jnp.int64).reshape(-1)
+        slots = self._slots(ids)                       # [n, P]
+        slot_keys = self.keys[slots]                   # [n, P]
+        hit = slot_keys == ids[:, None]
+        found = hit.any(axis=1)
+        # first hit slot (or slot 0 — masked out below)
+        idx = jnp.argmax(hit, axis=1)
+        sel = jnp.take_along_axis(slots, idx[:, None], axis=1)[:, 0]
+        rows = self.values[sel] * found[:, None].astype(self.values.dtype)
+        return rows, found
+
+    def insert(self, ids, rows):
+        """Functional batch insert (linear probing; existing keys are
+        overwritten). Raises if the probe window is exhausted — size the
+        capacity >= ~2x the working set."""
+        import jax
+        import jax.numpy as jnp
+        ids = jnp.asarray(ids, jnp.int64).reshape(-1)
+        rows = jnp.asarray(rows, self.values.dtype).reshape(
+            ids.shape[0], self.dim)
+        slots = self._slots(ids)
+
+        def body(i, carry):
+            keys, values, ok = carry
+            cand = slots[i]
+            kcand = keys[cand]
+            usable = (kcand == _EMPTY) | (kcand == ids[i])
+            j = jnp.argmax(usable)
+            slot = cand[j]
+            placed = usable.any()
+            keys = keys.at[slot].set(jnp.where(placed, ids[i], keys[slot]))
+            values = values.at[slot].set(
+                jnp.where(placed, rows[i], values[slot]))
+            return keys, values, ok & placed
+
+        keys, values, ok = jax.lax.fori_loop(
+            0, ids.shape[0], body,
+            (self.keys, self.values, jnp.asarray(True)))
+        if not bool(ok):
+            raise RuntimeError(
+                f"DeviceHashTable over capacity ({self.capacity} slots, "
+                f"{self.max_probes} probes) — grow it or evict")
+        self.keys, self.values = keys, values
+        self._count = int(np.sum(np.asarray(keys) != _EMPTY))
+        return self
+
+    def __len__(self):
+        return self._count
+
+
+class HeterPSCache:
+    """Read-through device cache over a PSClient sparse table."""
+
+    def __init__(self, client, table, dim, capacity=1 << 16,
+                 max_probes=16):
+        self.client = client
+        self.table = table
+        self.dev = DeviceHashTable(capacity, dim, max_probes)
+        self.hits = 0
+        self.misses = 0
+
+    def pull(self, ids):
+        """ids any-shape ints -> rows [n_unique, dim] (device), index
+        mapping like SparseEmbedding.pull. Misses fetch from the host PS
+        and populate the device table."""
+        import jax.numpy as jnp
+        ids_np = np.asarray(ids, np.int64).reshape(-1)
+        uniq, inv = np.unique(ids_np, return_inverse=True)
+        rows, found = self.dev.lookup(uniq)
+        found_np = np.asarray(found)
+        miss = uniq[~found_np]
+        self.hits += int(found_np.sum())
+        self.misses += len(miss)
+        if len(miss):
+            fetched = np.asarray(self.client.pull_sparse(self.table, miss),
+                                 np.float32)
+            self.dev.insert(miss, fetched)
+            rows = jnp.asarray(rows).at[jnp.asarray(~found_np)].set(
+                jnp.asarray(fetched, self.dev.values.dtype))
+        return rows, inv.reshape(np.shape(ids))
+
+    def push_grad(self, ids, grads):
+        """Push grads to the PS (authoritative update), then refresh the
+        cached copies with the server's post-update rows."""
+        ids_np = np.asarray(ids, np.int64).reshape(-1)
+        uniq, inv = np.unique(ids_np, return_inverse=True)
+        g = np.asarray(grads, np.float32).reshape(len(uniq), -1) \
+            if len(ids_np) == len(uniq) else None
+        if g is None:
+            # merge duplicate-id grads before the wire (MergeAdd)
+            flat = np.asarray(grads, np.float32).reshape(len(ids_np), -1)
+            g = np.zeros((len(uniq), flat.shape[1]), np.float32)
+            np.add.at(g, inv, flat)
+        self.client.push_sparse_grad(self.table, uniq, g)
+        fresh = np.asarray(self.client.pull_sparse(self.table, uniq),
+                           np.float32)
+        self.dev.insert(uniq, fresh)
+
+    def invalidate(self):
+        self.dev = DeviceHashTable(self.dev.capacity, self.dev.dim,
+                                   self.dev.max_probes)
+        return self

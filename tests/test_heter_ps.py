@@ -1,0 +1,91 @@
+"""HeterPS accelerator-resident cache (reference
+framework/fleet/heter_ps/hashtable.h; N22)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import (DeviceHashTable, HeterPSCache,
+                                       PSClient, PSServer)
+
+
+def test_device_hashtable_roundtrip():
+    t = DeviceHashTable(capacity=64, dim=3)
+    ids = np.array([5, 900, 12345678901234, 7], np.int64)
+    rows = np.arange(12, dtype=np.float32).reshape(4, 3)
+    t.insert(ids, rows)
+    got, found = t.lookup(np.array([7, 5, 999], np.int64))
+    assert list(np.asarray(found)) == [True, True, False]
+    np.testing.assert_allclose(np.asarray(got)[0], rows[3])
+    np.testing.assert_allclose(np.asarray(got)[1], rows[0])
+    np.testing.assert_allclose(np.asarray(got)[2], 0.0)
+    # overwrite existing key
+    t.insert(np.array([5], np.int64), np.full((1, 3), 9.0, np.float32))
+    got, _ = t.lookup(np.array([5], np.int64))
+    np.testing.assert_allclose(np.asarray(got)[0], 9.0)
+    assert len(t) == 4
+
+
+def test_device_hashtable_collisions_and_capacity():
+    # tiny table forces probing; all 8 inserts must still land
+    t = DeviceHashTable(capacity=16, dim=1, max_probes=16)
+    ids = np.arange(8, dtype=np.int64) * 16    # adversarial-ish stride
+    t.insert(ids, np.arange(8, dtype=np.float32).reshape(8, 1))
+    got, found = t.lookup(ids)
+    assert np.asarray(found).all()
+    np.testing.assert_allclose(np.asarray(got)[:, 0], np.arange(8))
+    with pytest.raises(RuntimeError):
+        big = DeviceHashTable(capacity=4, dim=1, max_probes=2)
+        big.insert(np.arange(16, dtype=np.int64),
+                   np.zeros((16, 1), np.float32))
+
+
+@pytest.fixture()
+def ps():
+    srv = PSServer(tables={"emb": {"type": "sparse", "dim": 4,
+                                   "optimizer": "sgd", "lr": 1.0,
+                                   "init": "uniform", "seed": 3}})
+    srv.start()
+    client = PSClient([srv.endpoint])
+    yield client
+    client.close()
+    srv.shutdown()
+
+
+def test_heter_cache_read_through_and_hit_tracking(ps):
+    cache = HeterPSCache(ps, "emb", dim=4, capacity=256)
+    ids = np.array([[1, 2], [2, 3]], np.int64)
+    rows, index = cache.pull(ids)
+    assert rows.shape == (3, 4) and index.shape == (2, 2)
+    assert cache.misses == 3 and cache.hits == 0
+    server_rows = np.asarray(ps.pull_sparse("emb", np.array([1, 2, 3])))
+    np.testing.assert_allclose(np.asarray(rows), server_rows, rtol=1e-6)
+    # second pull: all hits, no RPC needed for those rows
+    rows2, _ = cache.pull(ids)
+    assert cache.hits == 3 and cache.misses == 3
+    np.testing.assert_allclose(np.asarray(rows2), server_rows, rtol=1e-6)
+
+
+def test_heter_cache_push_refreshes(ps):
+    cache = HeterPSCache(ps, "emb", dim=4, capacity=256)
+    ids = np.array([10, 11], np.int64)
+    before, _ = cache.pull(ids)
+    g = np.ones((2, 4), np.float32)
+    cache.push_grad(ids, g)
+    # server applied sgd lr=1.0: row -= g; cache must match the server
+    after, _ = cache.pull(ids)
+    np.testing.assert_allclose(np.asarray(after),
+                               np.asarray(before) - 1.0, rtol=1e-5)
+    srv_rows = np.asarray(ps.pull_sparse("emb", ids))
+    np.testing.assert_allclose(np.asarray(after), srv_rows, rtol=1e-6)
+
+
+def test_heter_cache_duplicate_grad_merge(ps):
+    cache = HeterPSCache(ps, "emb", dim=4, capacity=64)
+    ids = np.array([20, 20, 21], np.int64)
+    cache.pull(ids)
+    grads = np.stack([np.full(4, 1.0), np.full(4, 2.0),
+                      np.full(4, 5.0)]).astype(np.float32)
+    before = np.asarray(ps.pull_sparse("emb", np.array([20, 21])))
+    cache.push_grad(ids, grads)
+    after = np.asarray(ps.pull_sparse("emb", np.array([20, 21])))
+    np.testing.assert_allclose(after[0], before[0] - 3.0, rtol=1e-5)
+    np.testing.assert_allclose(after[1], before[1] - 5.0, rtol=1e-5)
