@@ -633,3 +633,263 @@ def mean_iou(input, label, num_classes):  # noqa: A002
     miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
     wrong = (pred_cnt - correct).astype(jnp.int32)
     return miou.astype(jnp.float32), wrong, correct.astype(jnp.int32)
+
+
+# -- round-4 batch 4: industrial/CTR + misc reference families -------------
+# (cvm_op.cc, hash_op.cc, batch_fc_op.cu, rank_attention_op.cu,
+#  match_matrix_tensor_op.cc, fsp_op.cc, conv_shift_op.cc,
+#  filter_by_instag_op.cc, fake_quantize_op.cc, chunk_eval_op.cc,
+#  gru_unit_op.cc, lstm_unit_op.cc)
+
+__all__ += ["cvm", "hash_bucket", "batch_fc", "rank_attention",
+            "match_matrix_tensor", "fsp_matrix", "conv_shift",
+            "filter_by_instag", "fake_quantize_abs_max",
+            "fake_quantize_moving_average_abs_max",
+            "fake_channel_wise_quantize_abs_max", "dequantize_abs_max",
+            "chunk_eval", "gru_unit", "lstm_unit"]
+
+
+@defop
+def cvm(x, cvm_in=None, use_cvm=True):
+    """reference cvm_op.cc (CTR show/click feature): x's first two columns
+    are (show, click); use_cvm keeps them log-transformed, else drops."""
+    show = jnp.log(x[:, 0:1] + 1.0)
+    click = jnp.log(x[:, 1:2] + 1.0) - jnp.log(x[:, 0:1] + 1.0)
+    rest = x[:, 2:]
+    if use_cvm:
+        return jnp.concatenate([show, click, rest], axis=1)
+    return rest
+
+
+@defop
+def hash_bucket(x, num_hash=1, mod_by=100000007):
+    """reference hash_op.cc: ids -> num_hash bucket ids (multiplicative
+    hashing with distinct seeds)."""
+    ids = x.astype(jnp.int64)
+    seeds = jnp.asarray([(0x9E3779B1 * (i + 1)) | 1
+                         for i in range(num_hash)], jnp.int64)
+    h = ids[..., None] * seeds
+    h = h ^ (h >> 16)
+    return jnp.abs(h) % mod_by
+
+
+@defop
+def batch_fc(x, w, bias=None):
+    """reference batch_fc_op.cu: per-slot FC — x [slot, b, in],
+    w [slot, in, out]."""
+    out = jnp.einsum("sbi,sio->sbo", x, w)
+    if bias is not None:
+        out = out + bias[:, None]
+    return out
+
+
+@defop
+def rank_attention(x, rank_offset, rank_param, max_rank=3):
+    """reference rank_attention_op.cu (rank-aware CTR attention): each
+    row picks the parameter block of its rank pair. rank_offset [n, 1+2k]
+    with (ins_rank, (rank_i, index_i)...); simplified single-block form:
+    out[i] = x[i] @ rank_param[block(i)] where block = ins_rank-1."""
+    blk = jnp.clip(rank_offset[:, 0].astype(jnp.int32) - 1, 0,
+                   rank_param.shape[0] - 1)
+    return jnp.einsum("ni,nio->no", x, rank_param[blk])
+
+
+@defop
+def match_matrix_tensor(x, y, w):
+    """reference match_matrix_tensor_op.cc: bilinear match
+    x [n, lx, d], y [n, ly, d], w [d, t, d] -> [n, t, lx, ly]."""
+    return jnp.einsum("nad,dte,nbe->ntab", x, w, y)
+
+
+@defop
+def fsp_matrix(x, y):
+    """reference fsp_op.cc (distillation flow matrix):
+    x [n, c1, h, w], y [n, c2, h, w] -> [n, c1, c2] = mean_hw outer."""
+    n, c1, h, w = x.shape
+    c2 = y.shape[1]
+    return jnp.einsum("nahw,nbhw->nab", x, y) / (h * w)
+
+
+@defop
+def conv_shift(x, y):
+    """reference conv_shift_op.cc (NTM circular convolution):
+    x [b, m], y [b, n] (n odd, n<=m) -> circular correlation."""
+    m = x.shape[1]
+    n = y.shape[1]
+    half = n // 2
+    outs = []
+    for j in range(n):
+        shift = j - half
+        outs.append(jnp.roll(x, -shift, axis=1) * y[:, j:j + 1])
+    return sum(outs)
+
+
+def filter_by_instag(x, ins_tag, filter_tag):
+    """reference filter_by_instag_op.cc: keep rows whose tag set
+    intersects filter_tag (eager: output size data-dependent). x rows
+    align with ins_tag rows (list of per-row tag arrays or RaggedTensor).
+    Returns (filtered_rows Tensor, kept row indices)."""
+    import numpy as np
+
+    from ..core.ragged import RaggedTensor
+    from ..core.tensor import Tensor
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    tags = ins_tag.to_list() if isinstance(ins_tag, RaggedTensor) \
+        else [np.asarray(t).reshape(-1) for t in ins_tag]
+    fset = set(np.asarray(filter_tag).reshape(-1).tolist())
+    keep = [i for i, t in enumerate(tags)
+            if fset & set(np.asarray(t).tolist())]
+    idx = jnp.asarray(np.asarray(keep, np.int64))
+    from ._dispatch import wrap
+    return wrap(xv[idx]), wrap(idx)
+
+
+# ---- fake quantization family (reference fake_quantize_op.cc; the
+# QAT/PTQ layer machinery in paddle_tpu.quantization builds on these) ----
+
+@defop
+def fake_quantize_abs_max(x, bit_length=8):
+    """Returns (quantized-dequantized x, scale). STE handled by callers
+    (quantization module wraps with custom_vjp)."""
+    n = float(2 ** (bit_length - 1) - 1)
+    scale = jnp.max(jnp.abs(x))
+    q = jnp.round(x / jnp.maximum(scale, 1e-12) * n)
+    return jnp.clip(q, -n, n) / n * scale, scale
+
+
+@defop
+def fake_quantize_moving_average_abs_max(x, in_state, bit_length=8,
+                                         moving_rate=0.9):
+    n = float(2 ** (bit_length - 1) - 1)
+    cur = jnp.max(jnp.abs(x))
+    state = moving_rate * in_state + (1 - moving_rate) * cur
+    q = jnp.round(x / jnp.maximum(state, 1e-12) * n)
+    return jnp.clip(q, -n, n) / n * state, state
+
+
+@defop
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0):
+    n = float(2 ** (bit_length - 1) - 1)
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    q = jnp.round(x / jnp.maximum(scale, 1e-12) * n)
+    return jnp.clip(q, -n, n) / n * scale, jnp.squeeze(scale)
+
+
+@defop
+def dequantize_abs_max(x, scale, max_range):
+    return x.astype(jnp.float32) * scale / max_range
+
+
+def chunk_eval(inferences, labels, chunk_scheme="IOB", num_chunk_types=1,
+               seq_lengths=None):
+    """reference chunk_eval_op.cc: chunk-level precision/recall/F1 for
+    sequence labeling (IOB scheme). Host metric (eager), matching the
+    reference's CPU-only kernel. Returns (precision, recall, f1,
+    num_infer, num_label, num_correct)."""
+    import numpy as np
+
+    def extract(seq):
+        chunks = set()
+        start = None
+        ctype = None
+        for i, t in enumerate(list(seq) + [-1]):
+            t = int(t)
+            # IOB over num_chunk_types: tag = type*2 (B) / type*2+1 (I);
+            # anything >= 2*num_chunk_types (or -1) is Outside
+            if t < 0 or t >= 2 * num_chunk_types:
+                b, ty = None, None
+            else:
+                ty, isB = t // 2, (t % 2 == 0)
+                b = "B" if isB else "I"
+            if start is not None and (b is None or b == "B" or ty != ctype):
+                chunks.add((start, i - 1, ctype))
+                start, ctype = None, None
+            if b == "B":
+                start, ctype = i, ty
+            elif b == "I" and start is None:
+                start, ctype = i, ty
+        return chunks
+
+    inferences = np.asarray(
+        getattr(inferences, "numpy", lambda: inferences)())
+    labels = np.asarray(getattr(labels, "numpy", lambda: labels)())
+    if inferences.ndim == 1:
+        inferences, labels = inferences[None], labels[None]
+    n_inf = n_lab = n_cor = 0
+    for row in range(inferences.shape[0]):
+        L = int(seq_lengths[row]) if seq_lengths is not None \
+            else inferences.shape[1]
+        ic = extract(inferences[row][:L])
+        lc = extract(labels[row][:L])
+        n_inf += len(ic)
+        n_lab += len(lc)
+        n_cor += len(ic & lc)
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    return p, r, f1, n_inf, n_lab, n_cor
+
+
+@defop
+def gru_unit(x, hidden_prev, weight, bias=None):
+    """reference gru_unit_op.cc: one GRU step. x [b, 3d] (pre-projected
+    input), hidden_prev [b, d], weight [d, 3d] (hidden projections,
+    update|reset|candidate)."""
+    d = hidden_prev.shape[1]
+    hw = hidden_prev @ weight[:, :2 * d]
+    gates = x[:, :2 * d] + hw
+    if bias is not None:
+        gates = gates + bias[:2 * d]
+    u = jax.nn.sigmoid(gates[:, :d])
+    r = jax.nn.sigmoid(gates[:, d:2 * d])
+    c = x[:, 2 * d:] + (r * hidden_prev) @ weight[:, 2 * d:]
+    if bias is not None:
+        c = c + bias[2 * d:]
+    c = jnp.tanh(c)
+    h = u * hidden_prev + (1 - u) * c
+    return h, r, c
+
+
+@defop
+def lstm_unit(x, cell_prev, forget_bias=0.0):
+    """reference lstm_unit_op.cc: one LSTM step from pre-projected gates
+    x [b, 4d] (i|f|c|o), cell_prev [b, d] -> (hidden, cell)."""
+    d = cell_prev.shape[1]
+    i = jax.nn.sigmoid(x[:, :d])
+    f = jax.nn.sigmoid(x[:, d:2 * d] + forget_bias)
+    g = jnp.tanh(x[:, 2 * d:3 * d])
+    o = jax.nn.sigmoid(x[:, 3 * d:])
+    c = f * cell_prev + i * g
+    return o * jnp.tanh(c), c
+
+
+__all__ += ["accuracy", "auc"]
+
+
+@defop
+def accuracy(input, label, k=1):  # noqa: A002
+    """reference accuracy_op.cc: top-k accuracy of logits vs labels."""
+    topk_idx = jax.lax.top_k(input, k)[1]
+    lab = label.reshape(-1, 1).astype(topk_idx.dtype)
+    return jnp.mean(jnp.any(topk_idx == lab, axis=1).astype(jnp.float32))
+
+
+@defop
+def auc(predict, label, num_thresholds=200):
+    """reference auc_op.cc: ROC-AUC by thresholded TP/FP accumulation
+    (same binned estimator; single-batch functional form — the streaming
+    stat lives in paddle_tpu.metric.Auc)."""
+    pos_prob = predict[:, -1] if predict.ndim == 2 else predict.reshape(-1)
+    lab = label.reshape(-1).astype(jnp.float32)
+    thr = jnp.linspace(0.0, 1.0, num_thresholds)
+    pred = pos_prob[None, :] > thr[:, None]          # [t, n]
+    tp = jnp.sum(pred * lab[None], axis=1)
+    fp = jnp.sum(pred * (1 - lab[None]), axis=1)
+    tpr = tp / jnp.maximum(jnp.sum(lab), 1e-12)
+    fpr = fp / jnp.maximum(jnp.sum(1 - lab), 1e-12)
+    # integrate tpr over fpr; lexsort (fpr primary, tpr secondary) so the
+    # staircase runs lower-left to upper-right — a float32 epsilon
+    # tie-break underflows and leaves diagonal artifacts
+    order = jnp.lexsort((tpr, fpr))
+    return jnp.trapezoid(tpr[order], fpr[order])

@@ -1,0 +1,134 @@
+"""Round-4 op widening batch 4: CTR/industrial families, fake quant ops,
+chunk_eval, gru/lstm units, accuracy/auc (references cited per-op)."""
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+
+def T(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+def test_cvm_and_hash():
+    x = np.array([[3.0, 1.0, 5.0, 6.0]], "float32")
+    out = ops.cvm(T(x)).numpy()
+    np.testing.assert_allclose(out[0, 0], np.log(4.0), rtol=1e-6)
+    np.testing.assert_allclose(out[0, 1], np.log(2.0) - np.log(4.0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(out[0, 2:], [5, 6])
+    assert ops.cvm(T(x), use_cvm=False).shape == (1, 2)
+    h = ops.hash_bucket(T([[1], [2]], "int64"), num_hash=3,
+                        mod_by=1000).numpy()
+    assert h.shape == (2, 1, 3)
+    assert (h >= 0).all() and (h < 1000).all()
+    assert len(np.unique(h)) > 1
+
+
+def test_batch_fc_rank_attention_match_fsp():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 4).astype("float32")
+    w = rng.randn(2, 4, 5).astype("float32")
+    out = ops.batch_fc(T(x), T(w)).numpy()
+    np.testing.assert_allclose(out, np.einsum("sbi,sio->sbo", x, w),
+                               rtol=1e-5)
+    xr = rng.randn(4, 6).astype("float32")
+    ro = np.array([[1], [2], [1], [3]], "int32")
+    rp = rng.randn(3, 6, 2).astype("float32")
+    out = ops.rank_attention(T(xr), T(ro, "int32"), T(rp)).numpy()
+    np.testing.assert_allclose(out[1], xr[1] @ rp[1], rtol=1e-5)
+    a = rng.randn(2, 3, 4).astype("float32")
+    b = rng.randn(2, 5, 4).astype("float32")
+    wt = rng.randn(4, 2, 4).astype("float32")
+    mm = ops.match_matrix_tensor(T(a), T(b), T(wt)).numpy()
+    assert mm.shape == (2, 2, 3, 5)
+    np.testing.assert_allclose(
+        mm[0, 0, 0, 0], a[0, 0] @ wt[:, 0] @ b[0, 0], rtol=1e-4)
+    f1 = rng.randn(1, 3, 4, 4).astype("float32")
+    f2 = rng.randn(1, 5, 4, 4).astype("float32")
+    fsp = ops.fsp_matrix(T(f1), T(f2)).numpy()
+    np.testing.assert_allclose(
+        fsp, np.einsum("nahw,nbhw->nab", f1, f2) / 16, rtol=1e-5)
+
+
+def test_conv_shift():
+    x = np.array([[1.0, 2, 3, 4, 5]], "float32")
+    y = np.array([[0.0, 1.0, 0.0]], "float32")   # identity kernel
+    np.testing.assert_allclose(ops.conv_shift(T(x), T(y)).numpy(), x,
+                               rtol=1e-6)
+    y2 = np.array([[1.0, 0.0, 0.0]], "float32")  # shift by -1 tap
+    out = ops.conv_shift(T(x), T(y2)).numpy()
+    np.testing.assert_allclose(out, np.roll(x, 1, axis=1), rtol=1e-6)
+
+
+def test_filter_by_instag():
+    x = np.arange(12).reshape(4, 3).astype("float32")
+    tags = [[1], [2, 3], [4], [3]]
+    out, idx = ops.filter_by_instag(T(x), tags, [3])
+    np.testing.assert_array_equal(np.asarray(idx._value), [1, 3])
+    np.testing.assert_allclose(np.asarray(out._value), x[[1, 3]])
+
+
+def test_fake_quant_family():
+    rng = np.random.RandomState(1)
+    x = rng.randn(4, 6).astype("float32")
+    q, scale = ops.fake_quantize_abs_max(T(x))
+    assert abs(float(scale.numpy()) - np.abs(x).max()) < 1e-6
+    np.testing.assert_allclose(q.numpy(), x, atol=np.abs(x).max() / 127)
+    qc, sc = ops.fake_channel_wise_quantize_abs_max(T(x), quant_axis=0)
+    assert sc.shape == (4,)
+    np.testing.assert_allclose(qc.numpy(), x,
+                               atol=np.abs(x).max() / 127 + 1e-6)
+    q2, state = ops.fake_quantize_moving_average_abs_max(
+        T(x), T(np.asarray(1.0)))
+    assert np.isfinite(q2.numpy()).all()
+    deq = ops.dequantize_abs_max(T(np.array([127.0])), T(np.asarray(2.0)),
+                                 127.0)
+    np.testing.assert_allclose(deq.numpy(), [2.0], rtol=1e-6)
+
+
+def test_chunk_eval_iob():
+    # tags: B-0=0, I-0=1, Outside=2
+    label = np.array([[0, 1, 2, 0, 1]])
+    infer = np.array([[0, 1, 2, 0, 2]])  # second chunk truncated -> wrong
+    p, r, f1, ni, nl, nc = ops.chunk_eval(infer, label,
+                                          num_chunk_types=1)
+    assert (ni, nl, nc) == (2, 2, 1)
+    assert abs(p - 0.5) < 1e-9 and abs(r - 0.5) < 1e-9
+
+
+def test_gru_lstm_units_match_torch_cells():
+    rng = np.random.RandomState(2)
+    b, d = 3, 4
+    # lstm_unit vs torch.lstm_cell math (pre-projected gates)
+    gates = rng.randn(b, 4 * d).astype("float32")
+    c_prev = rng.randn(b, d).astype("float32")
+    h, c = ops.lstm_unit(T(gates), T(c_prev))
+    i, f, g, o = (gates[:, k * d:(k + 1) * d] for k in range(4))
+    sig = lambda z: 1 / (1 + np.exp(-z))  # noqa: E731
+    c_ref = sig(f) * c_prev + sig(i) * np.tanh(g)
+    np.testing.assert_allclose(c.numpy(), c_ref, rtol=1e-5)
+    np.testing.assert_allclose(h.numpy(), sig(o) * np.tanh(c_ref),
+                               rtol=1e-5)
+    # gru_unit: update gate u=1 keeps the previous hidden state
+    x = np.zeros((b, 3 * d), "float32")
+    x[:, :d] = 50.0                       # huge update gate logit
+    hp = rng.randn(b, d).astype("float32")
+    w = rng.randn(d, 3 * d).astype("float32") * 0.0
+    h, _, _ = ops.gru_unit(T(x), T(hp), T(w))
+    np.testing.assert_allclose(h.numpy(), hp, rtol=1e-4)
+
+
+def test_accuracy_and_auc():
+    logits = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], "float32")
+    label = np.array([1, 0, 0], "int64")
+    acc = float(ops.accuracy(T(logits), T(label, "int64")).numpy())
+    assert abs(acc - 2 / 3) < 1e-6
+    # perfectly separable scores -> auc 1
+    pred = np.array([0.1, 0.2, 0.8, 0.9], "float32")
+    lab = np.array([0, 0, 1, 1], "int64")
+    a = float(ops.auc(T(pred), T(lab, "int64")).numpy())
+    assert a > 0.99
+    a2 = float(ops.auc(T(pred[::-1].copy()), T(lab, "int64")).numpy())
+    assert a2 < 0.05
