@@ -1,0 +1,66 @@
+"""Round-4 transform breadth (reference vision/transforms/transforms.py:
+ColorJitter, Saturation/Contrast/Hue, RandomRotation, Grayscale)."""
+import numpy as np
+
+from paddle_tpu.vision import transforms as T
+
+
+def _img():
+    rng = np.random.RandomState(0)
+    return rng.rand(3, 8, 8).astype("float32")
+
+
+def test_grayscale_matches_luma():
+    img = _img()
+    g = T.Grayscale()(img)
+    ref = 0.299 * img[0] + 0.587 * img[1] + 0.114 * img[2]
+    np.testing.assert_allclose(g[0], ref, rtol=1e-5)
+    g3 = T.Grayscale(3)(img)
+    assert g3.shape == (3, 8, 8)
+    np.testing.assert_allclose(g3[0], g3[2])
+
+
+def test_saturation_contrast_zero_value_identity():
+    img = _img()
+    np.testing.assert_allclose(T.SaturationTransform(0.0)(img), img,
+                               rtol=1e-5)
+    np.testing.assert_allclose(T.ContrastTransform(0.0)(img), img,
+                               rtol=1e-5)
+    np.testing.assert_allclose(T.HueTransform(0.0)(img), img, atol=1e-5)
+
+
+def test_saturation_one_collapses_to_gray_at_f0():
+    img = _img()
+    np.random.seed(3)
+    out = T.SaturationTransform(0.9)(img)
+    assert out.shape == img.shape and np.isfinite(out).all()
+
+
+def test_hue_preserves_luma_roughly():
+    img = _img()
+    np.random.seed(1)
+    out = T.HueTransform(0.4)(img)
+    luma_in = 0.299 * img[0] + 0.587 * img[1] + 0.114 * img[2]
+    luma_out = 0.299 * out[0] + 0.587 * out[1] + 0.114 * out[2]
+    np.testing.assert_allclose(luma_out, luma_in, atol=1e-4)
+
+
+def test_color_jitter_runs_and_varies():
+    img = _img()
+    np.random.seed(2)
+    jit = T.ColorJitter(brightness=0.4, contrast=0.4, saturation=0.4,
+                        hue=0.2)
+    out = jit(img)
+    assert out.shape == img.shape
+    assert not np.allclose(out, img)
+
+
+def test_random_rotation():
+    img = np.zeros((1, 9, 9), "float32")
+    img[0, 4, :] = 1.0                       # horizontal line
+    np.random.seed(0)
+    rot = T.RandomRotation((90, 90))(img)    # exact 90 degrees
+    # line becomes vertical
+    assert rot[0, :, 4].sum() > 7
+    ident = T.RandomRotation((0, 0))(img)
+    np.testing.assert_allclose(ident, img, atol=1e-6)
