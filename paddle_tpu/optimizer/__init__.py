@@ -2,3 +2,5 @@ from . import lr  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Dpsgd,  # noqa: F401
                         Ftrl, Lamb, Lars, Momentum, Optimizer, RMSProp, SGD)
+from .averaging import (ExponentialMovingAverage, LookAhead,  # noqa: F401
+                        ModelAverage)

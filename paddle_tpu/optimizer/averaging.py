@@ -1,0 +1,179 @@
+"""Weight-averaging training utilities.
+
+Reference analogs: fluid/optimizer.py ExponentialMovingAverage (:4316),
+ModelAverage (:4790), LookaheadOptimizer (:5700). The reference rewrites
+programs with accumulator ops; here each is a small functional state
+machine over the layer's parameters — update() after each optimizer
+step, apply()/restore() (or the context form) to evaluate with the
+averaged weights.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+__all__ = ["ExponentialMovingAverage", "ModelAverage", "LookAhead"]
+
+
+def _named_params(obj):
+    """Accept a Layer or an iterable of parameters."""
+    if hasattr(obj, "named_parameters"):
+        return list(obj.named_parameters())
+    return [(getattr(p, "name", None) or f"param_{i}", p)
+            for i, p in enumerate(obj)]
+
+
+class ExponentialMovingAverage:
+    """shadow = decay * shadow + (1 - decay) * param, with the reference's
+    Adam-style bias correction (shadow / (1 - decay^t))."""
+
+    def __init__(self, network, decay=0.999):
+        import jax.numpy as jnp
+        self._params = _named_params(network)
+        self.decay = float(decay)
+        self._t = 0
+        self._shadow = {n: jnp.array(p._value) for n, p in self._params}
+        self._backup = None
+
+    def update(self):
+        self._t += 1
+        d = self.decay
+        for n, p in self._params:
+            self._shadow[n] = d * self._shadow[n] + (1.0 - d) * p._value
+
+    def apply(self):
+        """Swap bias-corrected EMA weights in (call restore() after)."""
+        if self._backup is not None:
+            raise RuntimeError("EMA already applied; call restore() first")
+        corr = 1.0 - self.decay ** max(self._t, 1)
+        self._backup = {n: p._value for n, p in self._params}
+        for n, p in self._params:
+            p.set_value(self._shadow[n] / corr)
+        return self
+
+    def restore(self):
+        if self._backup is None:
+            return self
+        for n, p in self._params:
+            p.set_value(self._backup[n])
+        self._backup = None
+        return self
+
+    @contextlib.contextmanager
+    def average_weights(self):
+        self.apply()
+        try:
+            yield
+        finally:
+            self.restore()
+
+    def state_dict(self):
+        return {"shadow": {n: np.asarray(v)
+                           for n, v in self._shadow.items()},
+                "t": self._t, "decay": self.decay}
+
+    def set_state_dict(self, state):
+        import jax.numpy as jnp
+        self._shadow = {n: jnp.asarray(v)
+                        for n, v in state["shadow"].items()}
+        self._t = int(state["t"])
+        self.decay = float(state["decay"])
+        return self
+
+
+class ModelAverage:
+    """Running average of parameters over an update window (reference
+    ModelAverage: accumulators restarted when the window exceeds
+    max_average_window)."""
+
+    def __init__(self, network, average_window_rate=0.15,
+                 min_average_window=10000, max_average_window=10000):
+        import jax.numpy as jnp
+        self._params = _named_params(network)
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._sum = {n: jnp.zeros_like(p._value) for n, p in self._params}
+        self._n = 0
+        self._updates = 0
+        self._backup = None
+
+    def update(self):
+        self._updates += 1
+        window = max(self.min_window,
+                     min(self.max_window,
+                         int(self._updates * self.rate) or 1))
+        if self._n >= window:
+            # restart the accumulator, seeded with the current average
+            for n, _ in self._params:
+                self._sum[n] = self._sum[n] / self._n
+            self._n = 1
+        for n, p in self._params:
+            self._sum[n] = self._sum[n] + p._value
+        self._n += 1
+
+    def apply(self):
+        if self._backup is not None:
+            raise RuntimeError("ModelAverage already applied")
+        self._backup = {n: p._value for n, p in self._params}
+        for n, p in self._params:
+            p.set_value(self._sum[n] / max(self._n, 1))
+        return self
+
+    def restore(self):
+        if self._backup is None:
+            return self
+        for n, p in self._params:
+            p.set_value(self._backup[n])
+        self._backup = None
+        return self
+
+    @contextlib.contextmanager
+    def average_weights(self):
+        self.apply()
+        try:
+            yield
+        finally:
+            self.restore()
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference LookaheadOptimizer; Zhang et
+    al. 2019): the inner optimizer takes k fast steps, then slow weights
+    move alpha of the way toward the fast weights and the fast weights
+    reset to them. Wraps any paddle_tpu Optimizer; works through both the
+    eager step() path and apply_gradients_pure (the blend itself is a
+    host-side rebind, like the reference's program-inserted assign ops)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._steps = 0
+        self._slow = None
+        self._params = list(getattr(inner_optimizer, "_parameter_list",
+                                    None) or [])
+
+    # pass-throughs -------------------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _ensure_slow(self):
+        if self._slow is None:
+            import jax.numpy as jnp
+            self._slow = [jnp.array(p._value) for p in self._params]
+
+    def step(self):
+        self._ensure_slow()
+        self.inner.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for i, p in enumerate(self._params):
+                slow = self._slow[i] + self.alpha * (p._value
+                                                     - self._slow[i])
+                self._slow[i] = slow
+                p.set_value(slow)
+
+    def clear_grad(self, *a, **k):
+        return self.inner.clear_grad(*a, **k)

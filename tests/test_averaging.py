@@ -1,0 +1,73 @@
+"""EMA / ModelAverage / LookAhead (reference fluid/optimizer.py
+ExponentialMovingAverage :4316, ModelAverage :4790, Lookahead :5700)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _fit_steps(net, opt, steps, ema=None, mavg=None):
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(16, 3).astype("float32"))
+    w = rng.randn(3, 1).astype("float32")
+    y = paddle.to_tensor(np.asarray(x._value) @ w)
+    loss_fn = nn.MSELoss()
+    for _ in range(steps):
+        loss = loss_fn(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if ema is not None:
+            ema.update()
+        if mavg is not None:
+            mavg.update()
+    return float(loss.numpy())
+
+
+def test_ema_apply_restore_roundtrip():
+    paddle.seed(0)
+    net = nn.Linear(3, 1, bias_attr=False)
+    opt = optimizer.SGD(learning_rate=0.2, parameters=net.parameters())
+    ema = optimizer.ExponentialMovingAverage(net, decay=0.5)
+    _fit_steps(net, opt, 20, ema=ema)
+    raw = np.asarray(net.weight._value).copy()
+    with ema.average_weights():
+        avg = np.asarray(net.weight._value).copy()
+        assert not np.allclose(avg, raw)
+    np.testing.assert_allclose(np.asarray(net.weight._value), raw)
+    # EMA trails but tracks training: close to the trained weights
+    assert np.abs(avg - raw).max() < 0.5
+    st = ema.state_dict()
+    ema2 = optimizer.ExponentialMovingAverage(net, decay=0.5)
+    ema2.set_state_dict(st)
+    with ema2.average_weights():
+        np.testing.assert_allclose(np.asarray(net.weight._value), avg,
+                                   rtol=1e-6)
+
+
+def test_model_average_window():
+    paddle.seed(1)
+    net = nn.Linear(3, 1, bias_attr=False)
+    opt = optimizer.SGD(learning_rate=0.2, parameters=net.parameters())
+    mavg = optimizer.ModelAverage(net, average_window_rate=1.0,
+                                  min_average_window=2,
+                                  max_average_window=4)
+    _fit_steps(net, opt, 12, mavg=mavg)
+    raw = np.asarray(net.weight._value).copy()
+    with mavg.average_weights():
+        avg = np.asarray(net.weight._value)
+        assert np.isfinite(avg).all() and not np.allclose(avg, raw)
+    np.testing.assert_allclose(np.asarray(net.weight._value), raw)
+
+
+def test_lookahead_converges_and_blends():
+    paddle.seed(2)
+    net = nn.Linear(3, 1, bias_attr=False)
+    inner = optimizer.SGD(learning_rate=0.3, parameters=net.parameters())
+    opt = optimizer.LookAhead(inner, alpha=0.5, k=3)
+    final = _fit_steps(net, opt, 30)
+    assert final < 0.05, final
+    assert opt._slow is not None
+    # slow weights equal fast weights right after a sync step (30 % 3 == 0)
+    np.testing.assert_allclose(np.asarray(opt._slow[0]),
+                               np.asarray(net.weight._value), rtol=1e-6)
