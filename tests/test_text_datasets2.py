@@ -1,0 +1,94 @@
+"""Round-4 text dataset breadth (reference text/datasets: imikolov,
+movielens, conll05, wmt14/16) — synthetic local archives, zero-egress."""
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.datasets import (Conll05st, Imikolov, Movielens,
+                                      WMT14, WMT16)
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    train = "the cat sat\nthe dog sat\nthe cat ran\n" * 20
+    valid = "the cat sat\n"
+    for name, content in (("ptb.train.txt", train),
+                          ("ptb.valid.txt", valid)):
+        (tmp_path / name).write_text(content)
+    tar = str(tmp_path / "simple-examples.tgz")
+    with tarfile.open(tar, "w:gz") as tf:
+        for name in ("ptb.train.txt", "ptb.valid.txt"):
+            tf.add(str(tmp_path / name),
+                   arcname=f"simple-examples/data/{name}")
+    ds = Imikolov(data_file=tar, data_type="NGRAM", window_size=3,
+                  min_word_freq=10, mode="train")
+    assert len(ds) > 0
+    assert all(g.shape == (3,) for g in ds)
+    seq = Imikolov(data_file=tar, data_type="SEQ", window_size=10,
+                   min_word_freq=10, mode="test")
+    src, trg = seq[0]
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+    with pytest.raises(RuntimeError):
+        Imikolov(download=True)
+
+
+def test_movielens(tmp_path):
+    users = "1::M::25::4::10001\n2::F::35::7::10002\n"
+    movies = "10::Toy Story (1995)::Animation|Comedy\n" \
+             "20::Heat (1995)::Action\n"
+    ratings = "1::10::5::100\n1::20::3::200\n2::10::4::300\n"
+    z = str(tmp_path / "ml-1m.zip")
+    with zipfile.ZipFile(z, "w") as zf:
+        zf.writestr("ml-1m/users.dat", users)
+        zf.writestr("ml-1m/movies.dat", movies)
+        zf.writestr("ml-1m/ratings.dat", ratings)
+    ds = Movielens(data_file=z, mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    uid, g, age, job, mid, cats, title, rating = ds[0]
+    assert (uid, g, age, job, mid) == (1, 0, 25, 4, 10)
+    assert rating == 5.0 and len(cats) == 2
+    # same title word "(1995)" shared across movies
+    m2 = [r for r in ds if r[4] == 20][0]
+    assert set(m2[6].tolist()) & set(title.tolist())
+
+
+def test_conll05(tmp_path):
+    words = "The\ncat\nsat\n\nDogs\nbark\n"
+    props = "- B-A0\n- I-A0\n- B-V\n\n- B-A0\n- B-V\n"
+    wf = tmp_path / "words.txt"
+    pf = tmp_path / "props.txt"
+    wf.write_text(words)
+    pf.write_text(props)
+    ds = Conll05st(words_file=str(wf), props_file=str(pf))
+    assert len(ds) == 2
+    w0, l0 = ds[0]
+    assert w0.shape == (3,) and l0.shape == (3,)
+    assert len(ds.word_dict) == 5 and len(ds.label_dict) == 3
+
+
+def _wmt_tar(tmp_path, names):
+    src = "ein haus\nzwei katzen\n"
+    trg = "a house\ntwo cats\n"
+    tar = str(tmp_path / "wmt.tgz")
+    with tarfile.open(tar, "w:gz") as tf:
+        for n, content in names.items():
+            p = tmp_path / n
+            p.write_text(content)
+            tf.add(str(p), arcname=f"data/{n}")
+    return tar
+
+
+def test_wmt14_and_16(tmp_path):
+    tar = _wmt_tar(tmp_path, {"train.src": "ein haus\nzwei katzen\n",
+                              "train.trg": "a house\ntwo cats\n"})
+    ds = WMT14(data_file=tar, mode="train")
+    src, tin, tout = ds[0]
+    assert tin[0] == ds.trg_dict["<s>"]
+    assert tout[-1] == ds.trg_dict["<e>"]
+    np.testing.assert_array_equal(tin[1:], tout[:-1])
+
+    tar16 = _wmt_tar(tmp_path, {"train.en": "a house\n",
+                                "train.de": "ein haus\n"})
+    ds16 = WMT16(data_file=tar16, mode="train")
+    assert len(ds16) == 1
