@@ -261,10 +261,19 @@ class TransformerDecoderLayer(Layer):
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
+        """cache: optional StaticKVCache for the self-attention —
+        incremental decoding (returns (out, new_cache)); the cache's
+        position index supplies causality, so tgt_mask is not needed on
+        the cached path (reference TransformerDecoderLayer cache=(Cache,
+        StaticCache), redesigned static-shape — see StaticKVCache)."""
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
-        tgt = self.self_attn(tgt, attn_mask=tgt_mask)
+        if isinstance(cache, StaticKVCache):
+            tgt, new_cache = self.self_attn(tgt, cache=cache)
+        else:
+            tgt = self.self_attn(tgt, attn_mask=tgt_mask)
+            new_cache = None
         tgt = residual + self.dropout1(tgt)
         if not self.normalize_before:
             tgt = self.norm1(tgt)
@@ -282,7 +291,12 @@ class TransformerDecoderLayer(Layer):
         tgt = residual + self.dropout3(tgt)
         if not self.normalize_before:
             tgt = self.norm3(tgt)
+        if new_cache is not None:
+            return tgt, new_cache
         return tgt
+
+    def gen_static_cache(self, batch_size, max_len, dtype="float32"):
+        return self.self_attn.gen_static_cache(batch_size, max_len, dtype)
 
 
 class TransformerDecoder(Layer):
@@ -297,13 +311,30 @@ class TransformerDecoder(Layer):
 
     def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
                 cache=None):
+        """cache: optional list of per-layer StaticKVCache (from
+        gen_static_cache) — incremental decoding; returns (out,
+        new_caches)."""
         out = tgt
-        for layer in self.layers:
-            out = layer(out, memory, tgt_mask=tgt_mask,
-                        memory_mask=memory_mask)
+        new_caches = [] if cache is not None else None
+        for i, layer in enumerate(self.layers):
+            if cache is not None:
+                out, c = layer(out, memory, memory_mask=memory_mask,
+                               cache=cache[i])
+                new_caches.append(c)
+            else:
+                out = layer(out, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
         if self.norm is not None:
             out = self.norm(out)
+        if new_caches is not None:
+            return out, new_caches
         return out
+
+    def gen_static_cache(self, batch_size, max_len, dtype="float32"):
+        """One StaticKVCache per layer (reference TransformerDecoder
+        gen_cache), for O(1)-per-token decoding."""
+        return [layer.gen_static_cache(batch_size, max_len, dtype)
+                for layer in self.layers]
 
 
 class Transformer(Layer):
