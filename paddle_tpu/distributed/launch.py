@@ -80,15 +80,94 @@ def launch(script, script_args=(), nproc_per_node=1, host="127.0.0.1",
               f"restart {attempt}/{elastic_retries}", flush=True)
 
 
+def launch_ps(script, script_args=(), server_num=1, worker_num=2,
+              host="127.0.0.1", start_port=6270, elastic_retries=0):
+    """PS-mode launcher (reference fleet launch --server_num/--worker_num,
+    python/paddle/distributed/fleet/launch.py): starts server processes
+    (TRAINING_ROLE=PSERVER) and worker processes (TRAINING_ROLE=TRAINER)
+    with the PADDLE_PSERVERS_IP_PORT_LIST contract. The job succeeds when
+    every WORKER exits 0 (servers are then terminated); a worker failure
+    kills the job and, with elastic_retries > 0, restarts servers AND
+    workers — scripts recover table state via PSClient.load_snapshot
+    (large_scale_kv checkpointing analog)."""
+    eps = [f"{host}:{start_port + i}" for i in range(server_num)]
+
+    def start_all(attempt):
+        base = dict(os.environ)
+        base["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(eps)
+        base["PADDLE_TRAINERS_NUM"] = str(worker_num)
+        base["PADDLE_LAUNCH_ATTEMPT"] = str(attempt)
+        servers = []
+        for i in range(server_num):
+            env = dict(base)
+            env.update({"TRAINING_ROLE": "PSERVER",
+                        "PADDLE_PSERVER_ID": str(i),
+                        "PADDLE_PORT": eps[i].rsplit(":", 1)[1]})
+            servers.append(subprocess.Popen(
+                [sys.executable, script, *script_args], env=env))
+        workers = []
+        for i in range(worker_num):
+            env = dict(base)
+            env.update({"TRAINING_ROLE": "TRAINER",
+                        "PADDLE_TRAINER_ID": str(i)})
+            workers.append(subprocess.Popen(
+                [sys.executable, script, *script_args], env=env))
+        return servers, workers
+
+    attempt = 0
+    while True:
+        servers, workers = start_all(attempt)
+        failed_ret = None
+        live = list(workers)
+        try:
+            while live and failed_ret is None:
+                for p in list(live):
+                    ret = p.poll()
+                    if ret is None:
+                        continue
+                    live.remove(p)
+                    if ret != 0:
+                        failed_ret = ret
+                for s in servers:          # a dead server fails the job
+                    ret = s.poll()
+                    if ret is not None and ret != 0 and failed_ret is None:
+                        failed_ret = ret
+                time.sleep(0.3)
+        finally:
+            for p in live + servers:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in live + servers:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        if failed_ret is None:
+            return 0
+        attempt += 1
+        if attempt > elastic_retries:
+            raise SystemExit(failed_ret)
+        print(f"[paddle_tpu.launch] ps job failed (rc={failed_ret}); "
+              f"elastic restart {attempt}/{elastic_retries}", flush=True)
+
+
 def main():
     import argparse
     ap = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     ap.add_argument("--nproc_per_node", type=int, default=1)
     ap.add_argument("--started_port", type=int, default=6170)
     ap.add_argument("--elastic_retries", type=int, default=0)
+    ap.add_argument("--server_num", type=int, default=0)
+    ap.add_argument("--worker_num", type=int, default=0)
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args()
+    if args.server_num or args.worker_num:
+        return launch_ps(args.script, args.script_args,
+                         server_num=max(args.server_num, 1),
+                         worker_num=max(args.worker_num, 1),
+                         start_port=args.started_port,
+                         elastic_retries=args.elastic_retries)
     return launch(args.script, args.script_args, args.nproc_per_node,
                   start_port=args.started_port,
                   elastic_retries=args.elastic_retries)
