@@ -247,3 +247,58 @@ def _decode_fn(net, max_new, temperature, top_k, eos_id, total, cache_dtype,
         return toks.swapaxes(0, 1)                         # [b, max_new]
 
     return jax.jit(run)
+
+
+def export_decode(net, path, batch_size, prompt_len, max_new_tokens,
+                  temperature=0.0, top_k=None, eos_token_id=None):
+    """Export the WHOLE generation program (prefill + scan decode over the
+    StaticKVCache) as a StableHLO artifact the inference Predictor can
+    run — the deployment form of incremental decoding (reference ships
+    this inside the C++ AnalysisPredictor; here it is one exported XLA
+    program). Inputs: input_ids [batch, prompt_len] int32, seed []
+    int32. Output: generated tokens [batch, max_new_tokens] int32.
+
+    Parameters are baked into the artifact as constants (same convention
+    as jit.save). Writes {path}.stablehlo + {path}.pdinfer.json.
+    """
+    import json
+    import os
+
+    import jax.export as jexport
+
+    params, buffers = net.functional_state()
+    total = prompt_len + int(max_new_tokens)
+    if total > net.config.max_seq_len:
+        raise ValueError("prompt_len + max_new_tokens exceeds max_seq_len")
+    cache_dtype = "bfloat16" if any(
+        v.dtype == jnp.bfloat16 for v in params.values()) else "float32"
+    fn = _decode_fn(net, int(max_new_tokens), float(temperature),
+                    None if top_k is None else int(top_k),
+                    None if eos_token_id is None else int(eos_token_id),
+                    total, cache_dtype, int(batch_size), int(prompt_len))
+
+    def run(ids, seed):
+        key = jax.random.PRNGKey(seed.astype(jnp.int32))
+        return fn(params, buffers, ids.astype(jnp.int64), key)
+
+    ids_spec = jax.ShapeDtypeStruct((int(batch_size), int(prompt_len)),
+                                    jnp.int32)
+    seed_spec = jax.ShapeDtypeStruct((), jnp.int32)
+    exported = jexport.export(jax.jit(run),
+                              platforms=("cpu", "tpu"))(ids_spec, seed_spec)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".stablehlo", "wb") as f:
+        f.write(bytes(exported.serialize()))
+    with open(path + ".pdinfer.json", "w") as f:
+        json.dump({"input_names": ["input_ids", "seed"],
+                   "output_names": ["tokens"],
+                   "input_dtypes": ["int32", "int32"],
+                   "decode": {"batch_size": int(batch_size),
+                              "prompt_len": int(prompt_len),
+                              "max_new_tokens": int(max_new_tokens),
+                              "temperature": float(temperature),
+                              "top_k": top_k,
+                              "eos_token_id": eos_token_id}}, f)
+    return path

