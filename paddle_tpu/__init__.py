@@ -70,7 +70,7 @@ _SUBMODULES = ("nn", "optimizer", "metric", "io", "amp", "static",
                "distributed", "vision", "jit", "hapi", "incubate",
                "profiler", "text", "sysconfig", "callbacks", "inference",
                "framework", "regularizer", "memory", "quantization",
-               "distribution", "version", "utils")
+               "distribution", "version", "utils", "fluid")
 
 
 # Lazily-injected non-module names (see __getattr__); enumerated so the

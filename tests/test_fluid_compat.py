@@ -1,0 +1,87 @@
+"""fluid v1 compatibility namespace (reference python/paddle/fluid/
+layers/nn.py fc :181, embedding :389 等): v1-style programs run on the
+2.0 implementations, eager and static."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+def test_fluid_layer_functions_eager():
+    fluid.layers._param_layers.clear()
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(4, 3, 2).astype("float32"))
+    out = fluid.layers.fc(x, size=5, act="relu", name="fc1")
+    assert out.shape == (4, 5) and (out.numpy() >= 0).all()
+    # same name reuses the same parameters
+    out2 = fluid.layers.fc(x, size=5, act="relu", name="fc1")
+    np.testing.assert_allclose(out.numpy(), out2.numpy())
+
+    ids = paddle.to_tensor(np.array([1, 2, 3], "int64"))
+    emb = fluid.layers.embedding(ids, size=[10, 4], name="emb1")
+    assert emb.shape == (3, 4)
+
+    img = paddle.to_tensor(rng.rand(2, 3, 8, 8).astype("float32"))
+    conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                               padding=1, act="relu", name="c1")
+    assert conv.shape == (2, 4, 8, 8)
+    pooled = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+    assert pooled.shape == (2, 4, 4, 4)
+    bn = fluid.layers.batch_norm(conv, name="bn1")
+    assert bn.shape == conv.shape
+
+
+def test_fluid_op_aliases():
+    a = paddle.to_tensor(np.array([[1.0, 2], [3, 4]], "float32"))
+    b = paddle.to_tensor(np.array([[5.0, 6], [7, 8]], "float32"))
+    np.testing.assert_allclose(
+        fluid.layers.elementwise_add(a, b).numpy(), [[6, 8], [10, 12]])
+    np.testing.assert_allclose(fluid.layers.mul(a, b).numpy(),
+                               a.numpy() @ b.numpy())
+    np.testing.assert_allclose(
+        fluid.layers.reduce_mean(a, dim=1).numpy(), [1.5, 3.5])
+    fc = fluid.layers.fill_constant([2, 2], "float32", 3.0)
+    assert (fc.numpy() == 3).all()
+    s = fluid.layers.shape(a)
+    np.testing.assert_array_equal(s.numpy(), [2, 2])
+    logits = paddle.to_tensor(np.array([[2.0, 0.1]], "float32"))
+    lab = paddle.to_tensor(np.array([0], "int64"))
+    ce = fluid.layers.cross_entropy(fluid.layers.softmax(logits), lab)
+    assert float(ce.numpy()) > 0
+
+
+def test_fluid_static_program():
+    fluid.layers._param_layers.clear()
+    paddle.enable_static()
+    try:
+        main = fluid.Program("fluid_v1")
+        with fluid.program_guard(main):
+            x = fluid.data("x", [-1, 4], "float32")
+            h = fluid.layers.fc(x, size=8, act="relu", name="h")
+            out = fluid.layers.fc(h, size=1, name="out")
+        exe = fluid.Executor()
+        res = exe.run(main, feed={"x": np.ones((3, 4), "float32")},
+                      fetch_list=[out])
+        assert res[0].shape == (3, 1)
+    finally:
+        paddle.disable_static()
+
+
+def test_fluid_io_roundtrip(tmp_path):
+    fluid.layers._param_layers.clear()
+    paddle.enable_static()
+    try:
+        main = fluid.Program("fluid_io")
+        with fluid.program_guard(main):
+            x = fluid.data("x", [-1, 2], "float32")
+            out = fluid.layers.fc(x, size=2, name="io_fc")
+        exe = fluid.Executor()
+        (before,) = exe.run(main, feed={"x": np.ones((1, 2), "float32")},
+                            fetch_list=[out])
+        fluid.io.save_persistables(exe, str(tmp_path), main)
+        fluid.io.load_persistables(exe, str(tmp_path), main)
+        (after,) = exe.run(main, feed={"x": np.ones((1, 2), "float32")},
+                           fetch_list=[out])
+        np.testing.assert_allclose(before, after)
+    finally:
+        paddle.disable_static()
