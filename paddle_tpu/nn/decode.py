@@ -60,15 +60,22 @@ class BeamSearchDecoder(Decoder):
         """inits: initial cell states for batch b (pytree of [b, ...])."""
         import jax.tree_util as jtu
         from ..core.tensor import Tensor
+        from .layer.transformer import StaticKVCache
 
         def tile(t):
+            if isinstance(t, StaticKVCache):
+                import jax.numpy as jnp
+                return StaticKVCache(jnp.repeat(t.k, self.beam_size, 0),
+                                     jnp.repeat(t.v, self.beam_size, 0),
+                                     t.index)
             v = t if isinstance(t, Tensor) else t
             e = ops.unsqueeze(v, [1])
             reps = [1, self.beam_size] + [1] * (v.ndim - 1)
             return self._merge(ops.tile(e, reps))
 
         states = jtu.tree_map(tile, inits,
-                              is_leaf=lambda t: isinstance(t, Tensor))
+                              is_leaf=lambda t: isinstance(
+                                  t, (Tensor, StaticKVCache)))
         leaf = jtu.tree_leaves(states)[0]
         b = leaf.shape[0] // self.beam_size
         ids = ops.full([b * self.beam_size], self.start_token, "int64")
@@ -103,15 +110,26 @@ class BeamSearchDecoder(Decoder):
         top_val, top_idx = ops.topk(joint, k, axis=-1)   # [b, k]
         parent = top_idx // V                            # beam index
         token = top_idx % V                              # vocab id
-        # gather states by parent beam
+        # gather states by parent beam; StaticKVCache states reorder their
+        # k/v buffers along batch*beam and keep the shared fill index —
+        # incremental decoding under beam search (reference beam_search_op
+        # + the C++ predictor's cache reorder)
         flat_parent = (np.arange(b)[:, None] * k
                        + np.asarray(parent._value)).reshape(-1)
         import jax.tree_util as jtu
         from ..core.tensor import Tensor
+        from .layer.transformer import StaticKVCache
         gather_idx = paddle.to_tensor(flat_parent.astype("int64"))
+
+        def gather_state(t):
+            if isinstance(t, StaticKVCache):
+                gi = gather_idx._value
+                return StaticKVCache(t.k[gi], t.v[gi], t.index)
+            return ops.gather(t, gather_idx)
+
         new_states = jtu.tree_map(
-            lambda t: ops.gather(t, gather_idx),
-            new_states, is_leaf=lambda t: isinstance(t, Tensor))
+            gather_state, new_states,
+            is_leaf=lambda t: isinstance(t, (Tensor, StaticKVCache)))
         token_flat = ops.reshape(token, [-1]).astype("int64")
         self._cum = ops.reshape(top_val, [-1])
         finished_now = np.asarray(token_flat._value) == self.end_token
