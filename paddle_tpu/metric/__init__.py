@@ -183,3 +183,69 @@ def accuracy(input, label, k=1):  # noqa: A002
     from ..ops._dispatch import wrap
     import jax.numpy as jnp
     return wrap(jnp.asarray(correct.mean(), jnp.float32))
+
+
+class ChunkEvaluator(Metric):
+    """Chunk-level precision/recall/F1 for sequence labeling (reference
+    fluid/metrics.py ChunkEvaluator over chunk_eval_op.cc; IOB scheme via
+    ops.chunk_eval)."""
+
+    def __init__(self, num_chunk_types=1, chunk_scheme="IOB", name=None):
+        self._name = name or "chunk"
+        self.num_chunk_types = num_chunk_types
+        self.chunk_scheme = chunk_scheme
+        self.reset()
+
+    def reset(self):
+        self.num_infer = 0
+        self.num_label = 0
+        self.num_correct = 0
+
+    def update(self, inferences, labels, seq_lengths=None):
+        from ..ops import chunk_eval
+        _, _, _, ni, nl, nc = chunk_eval(
+            inferences, labels, chunk_scheme=self.chunk_scheme,
+            num_chunk_types=self.num_chunk_types, seq_lengths=seq_lengths)
+        self.num_infer += ni
+        self.num_label += nl
+        self.num_correct += nc
+
+    def accumulate(self):
+        p = self.num_correct / self.num_infer if self.num_infer else 0.0
+        r = self.num_correct / self.num_label if self.num_label else 0.0
+        f1 = 2 * p * r / (p + r) if (p + r) else 0.0
+        return p, r, f1
+
+    def name(self):
+        return self._name
+
+
+class EditDistance(Metric):
+    """Streaming average edit distance (reference fluid/metrics.py
+    EditDistance over edit_distance_op.cc)."""
+
+    def __init__(self, normalized=True, name=None):
+        self._name = name or "edit_distance"
+        self.normalized = normalized
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, hyps, refs):
+        import numpy as np
+
+        from ..ops import edit_distance
+        d, n = edit_distance(hyps, refs, normalized=self.normalized)
+        self.total += float(np.asarray(d.numpy()).sum())
+        self.count += n
+
+    def accumulate(self):
+        return self.total / self.count if self.count else 0.0
+
+    def name(self):
+        return self._name
+
+
+__all__ += ["ChunkEvaluator", "EditDistance"]

@@ -132,3 +132,19 @@ def test_accuracy_and_auc():
     assert a > 0.99
     a2 = float(ops.auc(T(pred[::-1].copy()), T(lab, "int64")).numpy())
     assert a2 < 0.05
+
+
+def test_metric_chunk_evaluator_and_edit_distance():
+    from paddle_tpu.metric import ChunkEvaluator, EditDistance
+    ce = ChunkEvaluator(num_chunk_types=1)
+    ce.update(np.array([[0, 1, 2, 0, 2]]), np.array([[0, 1, 2, 0, 1]]))
+    p, r, f1 = ce.accumulate()
+    assert 0 < p <= 1 and 0 < r <= 1 and 0 < f1 <= 1
+    ce.update(np.array([[0, 1]]), np.array([[0, 1]]))  # perfect batch
+    p2, _, _ = ce.accumulate()
+    assert p2 >= p
+
+    ed = EditDistance(normalized=False)
+    ed.update([[1, 2, 3]], [[1, 3]])
+    ed.update([[4]], [[4]])
+    assert ed.accumulate() == 0.5          # (1 + 0) / 2
