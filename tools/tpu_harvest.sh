@@ -1,0 +1,44 @@
+#!/bin/bash
+# One-shot TPU measurement harvest for round 4 (run when the chip is live):
+#   1. full bench (bert + resnet + decode + longseq) -> stdout JSON lines
+#   2. profiler breakdown artifact -> BENCH_PROFILE_r04.txt (VERDICT item 7)
+# Usage: bash tools/tpu_harvest.sh
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== probe ==" >&2
+timeout 120 python -c "
+import jax, jax.numpy as jnp, numpy as np
+print('tpu:', jax.devices())
+print('warm:', float(np.asarray((jnp.ones((8,8))@jnp.ones((8,8))).sum())))" || {
+  echo "TPU unreachable" >&2; exit 1; }
+
+echo "== bench (all modes) ==" >&2
+timeout 3000 python bench.py 2>bench_r04_stderr.log
+tail -5 bench_r04_stderr.log >&2 || true
+
+echo "== profile artifact ==" >&2
+BENCH_PROFILE=1 BENCH_MODE=bert BENCH_STEPS=20 timeout 1200 \
+  python bench.py 2>BENCH_PROFILE_r04.txt 1>/dev/null || true
+grep -c . BENCH_PROFILE_r04.txt >&2 || true
+
+echo "== flash block sanity at long seq ==" >&2
+timeout 900 python - <<'EOF' 2>/dev/null || true
+import time, jax, jax.numpy as jnp, numpy as np
+import paddle_tpu as paddle
+paddle.set_flags({"FLAGS_flash_min_seq": 0})
+from paddle_tpu.nn import functional as F
+def timeit(f, *a, n=20):
+    o = f(*a); _ = float(np.asarray(o.reshape(-1)[0], np.float32))
+    t0 = time.perf_counter()
+    for _ in range(n): o = f(o, *a[1:])
+    _ = float(np.asarray(o.reshape(-1)[0], np.float32))
+    return (time.perf_counter()-t0)/n*1000
+key = jax.random.PRNGKey(0)
+for s in (2048, 4096):
+    q = jax.random.normal(key, (1, 12, s, 64), jnp.bfloat16)
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    fl = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    print(f"s={s}: flash fwd {timeit(fl, q, q, q):.2f} ms")
+EOF
+echo "harvest done" >&2
