@@ -118,3 +118,78 @@ def graph_viz(program, path=None):
         with open(path, "w") as f:
             f.write(dot)
     return dot
+
+
+_IMPURE_MARKERS = ("rand", "normal", "uniform", "bernoulli", "multinomial",
+                   "poisson", "dropout", "gumbel", "seed", "shuffle")
+
+
+def _is_pure(op):
+    return not any(m in op.name for m in _IMPURE_MARKERS)
+
+
+# NOTE on constant folding: it happens at TRACE time by construction —
+# ops whose inputs are all literals never touch a Variable, so record_op
+# executes them eagerly and their results enter the program as baked
+# constants (tests/test_passes2.py asserts this design property). The
+# pass tier therefore owns what tracing can't see: CSE below, dead-op
+# elimination, and visualization.
+
+
+@register_pass("cse")
+def common_subexpression_elimination(program):
+    """Merge ops with identical (name, inputs, kwargs) into one
+    (reference ir/ identity-graph dedup passes): later duplicates' outputs
+    are rewired to the first occurrence's variables. Impure ops are never
+    merged."""
+    import copy
+
+    def sig(op, remap):
+        parts = [op.name, op.n_args]
+        for x in op.flat:
+            if isinstance(x, _Ref):
+                parts.append(("ref", remap.get(x.var_id, x.var_id)))
+            else:
+                try:
+                    parts.append(("lit", np.asarray(x).tobytes()
+                                  if hasattr(x, "shape") else x))
+                except Exception:
+                    return None
+        return tuple(str(p) for p in parts)
+
+    import numpy as np
+    roots = _live_ids(program)
+    seen = {}
+    remap = {}
+    new_ops = []
+    for op in program.ops:
+        if not _is_pure(op):
+            new_ops.append(op)
+            continue
+        s = sig(op, remap)
+        dup = (s is not None and s in seen
+               and not any(oid in roots for oid in op.out_ids))
+        if dup:
+            for mine, theirs in zip(op.out_ids, seen[s]):
+                remap[mine] = theirs
+            continue
+        op2 = copy.copy(op)
+        # rewrite remapped input refs
+        op2.flat = [x if not isinstance(x, _Ref) or x.var_id not in remap
+                    else _remapped_ref(x, remap[x.var_id])
+                    for x in op.flat]
+        if s is not None and s not in seen:
+            seen[s] = list(op.out_ids)
+        new_ops.append(op2)
+    new = copy.copy(program)
+    new.ops = new_ops
+    new._cse_remap = dict(remap)
+    new._version = getattr(program, "_version", 0) + 1
+    return new
+
+
+def _remapped_ref(ref, new_id):
+    import copy
+    r = copy.copy(ref)
+    r.var_id = new_id
+    return r
