@@ -1,0 +1,105 @@
+"""Custom-op / C++ extension story (reference
+python/paddle/utils/cpp_extension/: CppExtension + load() JIT-compiling
+user kernels, and the PD_BUILD_OP custom operator registration).
+
+TPU design delta: DEVICE custom ops here are Python — `@defop` +
+`jax.custom_vjp` (or a Pallas kernel) IS the custom-op API, and
+`register_custom_op` below wires such a function into OP_REGISTRY so it
+dispatches, records into static Programs, and differentiates like any
+built-in. `load()` keeps the reference's host-side C++ JIT path for what
+native code is still for on a TPU host — parsers, samplers, feature
+extractors (the _native tier) — compiling sources with g++ and returning
+a ctypes library.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+__all__ = ["load", "CppExtension", "register_custom_op"]
+
+_lock = threading.Lock()
+
+
+def _build_dir():
+    d = os.environ.get("PADDLE_EXTENSION_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Build spec (reference cpp_extension.CppExtension)."""
+
+    def __init__(self, sources, extra_compile_args=None,
+                 include_dirs=None, name=None):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.include_dirs = list(include_dirs or [])
+        self.name = name
+
+
+def load(name, sources=None, extra_cxx_cflags=None, include_dirs=None,
+         verbose=False, build_directory=None):
+    """JIT-compile C++ sources into {build_dir}/lib{name}.so and load it
+    with ctypes (reference cpp_extension.load, minus pybind: the returned
+    handle is a ctypes.CDLL — declare argtypes/restype and call; ctypes
+    calls release the GIL like the _native tier)."""
+    import ctypes
+
+    if isinstance(name, CppExtension):
+        ext = name
+        name = ext.name or "ext"
+        sources = ext.sources
+        extra_cxx_cflags = ext.extra_compile_args
+        include_dirs = ext.include_dirs
+    if not sources:
+        raise ValueError("load() needs C++ sources")
+    out_dir = build_directory or _build_dir()
+    so = os.path.join(out_dir, f"lib{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    with _lock:
+        stale = (not os.path.exists(so)
+                 or any(os.path.getmtime(so) < os.path.getmtime(s)
+                        for s in srcs))
+        if stale:
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                   *srcs, "-o", so + ".tmp"]
+            for inc in include_dirs or []:
+                cmd.append(f"-I{inc}")
+            cmd += list(extra_cxx_cflags or [])
+            if verbose:
+                print("[cpp_extension]", " ".join(cmd))
+            subprocess.run(cmd, check=True, capture_output=not verbose)
+            os.replace(so + ".tmp", so)
+    return ctypes.CDLL(so)
+
+
+def register_custom_op(name=None, vjp=None):
+    """Register a Python/Pallas function as a first-class operator
+    (reference PD_BUILD_OP + custom_operator.cc load_op_library): the
+    function lands in OP_REGISTRY, dispatches over Tensors, records into
+    static Programs, and — when `vjp` is given — differentiates through
+    the tape via jax.custom_vjp.
+
+        @register_custom_op(vjp=(fwd_res_fn, bwd_fn))
+        def my_op(x, alpha=1.0): ...
+
+    vjp: (fwd, bwd) pair with jax.custom_vjp semantics; omit for ops
+    differentiable by tracing."""
+    import functools
+
+    from ..ops._dispatch import defop
+
+    def deco(fn):
+        raw = fn
+        if vjp is not None:
+            import jax
+            fwd, bwd = vjp
+            wrapped = jax.custom_vjp(fn)
+            wrapped.defvjp(fwd, bwd)
+            raw = functools.wraps(fn)(wrapped)
+        return defop(raw, name=name)
+
+    return deco
