@@ -7,7 +7,7 @@ reference fluid/incubate/checkpoint/auto_checkpoint.py analog).
 """
 import importlib as _importlib
 
-_SUBMODULES = ("functional", "checkpoint")
+_SUBMODULES = ("functional", "checkpoint", "optimizer")
 
 
 def __getattr__(name):

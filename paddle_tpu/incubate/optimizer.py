@@ -1,0 +1,8 @@
+"""paddle.incubate.optimizer — reference incubate optimizer homes
+(python/paddle/incubate/optimizer/lookahead.py, modelaverage.py). The
+implementations live in paddle_tpu.optimizer.averaging; this module is
+the API-parity mount point."""
+from ..optimizer.averaging import (ExponentialMovingAverage,  # noqa: F401
+                                   LookAhead, ModelAverage)
+
+__all__ = ["LookAhead", "ModelAverage", "ExponentialMovingAverage"]
