@@ -1,0 +1,140 @@
+"""Round-4 detection-zoo widening (reference operators/detection/):
+anchor_generator, density_prior_box, matrix_nms, target_assign,
+polygon_box_transform, FPN distribute/collect, box_decoder_and_assign,
+mine_hard_examples, yolov3_loss."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+
+def T(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+def test_anchor_generator_shapes_and_centers():
+    feat = T(np.zeros((1, 8, 4, 5)))
+    anchors, var = ops.anchor_generator(feat, anchor_sizes=[32, 64],
+                                        aspect_ratios=[1.0, 2.0],
+                                        stride=(16.0, 16.0))
+    assert anchors.shape == (4, 5, 4, 4) and var.shape == anchors.shape
+    a = anchors.numpy()
+    # cell (0,0) anchors center at offset*stride = 8
+    np.testing.assert_allclose((a[0, 0, 0, 0] + a[0, 0, 0, 2]) / 2, 8.0,
+                               atol=1e-4)
+    # square size-32 anchor has area 32^2
+    w = a[0, 0, 0, 2] - a[0, 0, 0, 0]
+    h = a[0, 0, 0, 3] - a[0, 0, 0, 1]
+    np.testing.assert_allclose(w * h, 1024.0, rtol=1e-4)
+
+
+def test_density_prior_box():
+    feat = T(np.zeros((1, 3, 2, 2)))
+    img = T(np.zeros((1, 3, 32, 32)))
+    boxes, var = ops.density_prior_box(feat, img, densities=[2],
+                                       fixed_sizes=[8.0],
+                                       fixed_ratios=[1.0], clip=True)
+    assert boxes.shape == (2, 2, 4, 4)
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    # all 4 shifted centers distinct
+    centers = (b[0, 0, :, :2] + b[0, 0, :, 2:]) / 2
+    assert len({tuple(c) for c in centers.round(4).tolist()}) == 4
+
+
+def test_matrix_nms_decays_overlaps():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     "float32")
+    scores = np.array([[0.9, 0.8, 0.7]], "float32")
+    out, idx = ops.matrix_nms(T(boxes), T(scores), score_threshold=0.1,
+                              post_threshold=0.0)
+    o = np.asarray(out._value)
+    assert o.shape[1] == 6 and o.shape[0] == 3
+    # top box keeps its score; overlapping second decays; far third ~keeps
+    srt = o[np.argsort(-o[:, 1])]
+    assert abs(srt[0, 1] - 0.9) < 1e-5
+    decayed = o[np.asarray(idx._value) == 1][0, 1]
+    assert decayed < 0.8 * 0.7
+
+
+def test_target_assign():
+    x = T(np.arange(2 * 3 * 2).reshape(2, 3, 2))
+    mi = T(np.array([[0, 2, -1], [1, -1, 0]]), "int64").astype("int32")
+    out, w = ops.target_assign(x, mi, mismatch_value=-9)
+    o = np.asarray(out._value)
+    np.testing.assert_allclose(o[0, 0], [0, 1])
+    np.testing.assert_allclose(o[0, 1], [4, 5])
+    np.testing.assert_allclose(o[0, 2], [-9, -9])
+    np.testing.assert_allclose(np.asarray(w._value)[..., 0],
+                               [[1, 1, 0], [1, 0, 1]])
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 4, 2, 3), "float32")
+    out = ops.polygon_box_transform(T(x)).numpy()
+    # with zero offsets, even channels = 4*x coord, odd = 4*y coord
+    np.testing.assert_allclose(out[0, 0, 0], [0, 4, 8])
+    np.testing.assert_allclose(out[0, 1, 1], [4, 4, 4])
+
+
+def test_fpn_distribute_and_collect():
+    rois = np.array([[0, 0, 10, 10],       # small -> low level
+                     [0, 0, 300, 300],     # big  -> high level
+                     [0, 0, 60, 60]], "float32")
+    outs, restore = ops.distribute_fpn_proposals(T(rois), 2, 5, 4, 224)
+    sizes = [int(np.asarray(o._value).shape[0]) for o in outs]
+    assert sum(sizes) == 3 and sizes[0] >= 1
+    # restore index maps original row -> its position in the concat
+    cat = np.concatenate([np.asarray(o._value) for o in outs])
+    np.testing.assert_allclose(cat[np.asarray(restore._value)], rois)
+    col = ops.collect_fpn_proposals(
+        [T(rois[:2]), T(rois[2:])],
+        [T(np.array([0.3, 0.9])), T(np.array([0.5]))], post_nms_top_n=2)
+    c = np.asarray(col._value)
+    np.testing.assert_allclose(c[0], rois[1])   # highest score first
+    assert c.shape == (2, 4)
+
+
+def test_box_decoder_and_assign():
+    priors = np.array([[0, 0, 10, 10]], "float32")
+    pvar = np.array([[0.1, 0.1, 0.2, 0.2]], "float32")
+    tb = np.zeros((1, 8), "float32")            # 2 classes, zero deltas
+    score = np.array([[0.2, 0.8]], "float32")
+    decoded, assigned = ops.box_decoder_and_assign(T(priors), T(pvar),
+                                                   T(tb), T(score))
+    np.testing.assert_allclose(np.asarray(assigned._value)[0],
+                               priors[0], rtol=1e-5)
+    assert decoded.shape == (1, 8)
+
+
+def test_mine_hard_examples():
+    loss = np.array([[0.9, 0.1, 0.8, 0.2, 0.5]], "float32")
+    mi = np.array([[3, -1, -1, -1, -1]], "int64")   # 1 positive, 4 negs
+    mask = ops.mine_hard_examples(T(loss), T(mi, "int64"),
+                                  neg_pos_ratio=2.0).numpy()
+    # top-2 loss negatives are slots 2 (0.8) and 4 (0.5)
+    np.testing.assert_array_equal(mask[0], [0, 0, 1, 0, 1])
+
+
+def test_yolov3_loss_trains_signal():
+    import jax
+    rng = np.random.RandomState(0)
+    n, a, c, h, w = 1, 3, 4, 4, 4
+    x = rng.randn(n, a * (5 + c), h, w).astype("float32") * 0.1
+    gt_box = np.array([[[0.5, 0.5, 0.4, 0.4]]], "float32")
+    gt_label = np.array([[2]], "int64")
+    loss = ops.yolov3_loss(T(x), T(gt_box), T(gt_label, "int64"),
+                           anchors=[10, 13, 16, 30, 33, 23],
+                           anchor_mask=[0, 1, 2], class_num=c,
+                           downsample_ratio=8)
+    v = float(np.asarray(loss._value)[0])
+    assert np.isfinite(v) and v > 0
+    # differentiable
+    xt = T(x)
+    xt.stop_gradient = False
+    out = ops.yolov3_loss(xt, T(gt_box), T(gt_label, "int64"),
+                          anchors=[10, 13, 16, 30, 33, 23],
+                          anchor_mask=[0, 1, 2], class_num=c,
+                          downsample_ratio=8)
+    out.sum().backward()
+    assert np.abs(np.asarray(xt.grad._value)).sum() > 0
