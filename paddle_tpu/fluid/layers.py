@@ -46,7 +46,6 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,  # noqa
     in_dim = int(np.prod(x.shape[num_flatten_dims:]))
     if len(x.shape) > num_flatten_dims + 1:
         x = ops.reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
-    key = ("fc", name or id(input) if name else ("fc", in_dim, size))
     layer = _layer_cached(("fc", name, in_dim, size), lambda: _nn.Linear(
         in_dim, size, weight_attr=param_attr, bias_attr=bias_attr))
     out = layer(x)
