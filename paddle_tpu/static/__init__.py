@@ -206,7 +206,15 @@ def cuda_places(device_ids=None):
 class _StaticNN:
     """paddle.static.nn.* builder shims (reference fluid/layers/nn.py
     LayerHelper-based builders). Each creates the layer's parameters in the
-    current program and applies it immediately."""
+    current program and applies it immediately. Names not defined here
+    fall through to the fluid.layers v1 adapters (embedding, conv2d,
+    pool2d, dropout, sequence_*, ...)."""
+
+    def __getattr__(self, name):
+        from ..fluid import layers as _fl
+        if hasattr(_fl, name):
+            return getattr(_fl, name)
+        raise AttributeError(f"paddle.static.nn has no attribute {name!r}")
 
     @staticmethod
     def fc(x, size, num_flatten_dims=1, activation=None, name=None,
