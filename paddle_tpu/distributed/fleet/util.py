@@ -76,3 +76,83 @@ class UtilBase:
         from ..env import get_rank
         if get_rank() == rank_id:
             print(message)
+
+
+class HDFSClient:
+    """HDFS filesystem client (reference fleet/utils/fs.py HDFSClient):
+    shells out to `hadoop fs` exactly like the reference — pass
+    hadoop_home and the fs.default.name/ugi configs. Zero-egress images
+    without a hadoop binary get a clear error at call time, not import
+    time."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300):
+        import os as _os
+        self._hadoop = (_os.path.join(hadoop_home, "bin", "hadoop")
+                        if hadoop_home else "hadoop")
+        self._configs = dict(configs or {})
+        self._timeout = time_out
+
+    def _run(self, *args):
+        import subprocess
+        cmd = [self._hadoop, "fs"]
+        for k, v in self._configs.items():
+            cmd += ["-D", f"{k}={v}"]
+        cmd += list(args)
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True,
+                               timeout=self._timeout)
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                f"HDFSClient: hadoop binary {self._hadoop!r} not found — "
+                "set hadoop_home (the reference shells out the same way)"
+            ) from e
+        return r.returncode, r.stdout, r.stderr
+
+    def is_exist(self, path):
+        rc, _, _ = self._run("-test", "-e", path)
+        return rc == 0
+
+    def is_dir(self, path):
+        rc, _, _ = self._run("-test", "-d", path)
+        return rc == 0
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def ls_dir(self, path):
+        rc, out, err = self._run("-ls", path)
+        if rc != 0:
+            raise RuntimeError(f"hdfs ls failed: {err.strip()}")
+        dirs, files = [], []
+        for ln in out.splitlines():
+            parts = ln.split()
+            if len(parts) < 8:
+                continue
+            name = parts[-1].rsplit("/", 1)[-1]
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def upload(self, local_path, fs_path):
+        rc, _, err = self._run("-put", "-f", local_path, fs_path)
+        if rc != 0:
+            raise RuntimeError(f"hdfs put failed: {err.strip()}")
+
+    def download(self, fs_path, local_path):
+        rc, _, err = self._run("-get", fs_path, local_path)
+        if rc != 0:
+            raise RuntimeError(f"hdfs get failed: {err.strip()}")
+
+    def mkdirs(self, path):
+        rc, _, err = self._run("-mkdir", "-p", path)
+        if rc != 0:
+            raise RuntimeError(f"hdfs mkdir failed: {err.strip()}")
+
+    def delete(self, path):
+        rc, _, err = self._run("-rm", "-r", "-f", path)
+        if rc != 0:
+            raise RuntimeError(f"hdfs rm failed: {err.strip()}")
+
+    def mv(self, src, dst):
+        rc, _, err = self._run("-mv", src, dst)
+        if rc != 0:
+            raise RuntimeError(f"hdfs mv failed: {err.strip()}")

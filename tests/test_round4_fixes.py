@@ -213,3 +213,39 @@ def test_communicator_batches_before_send():
     assert len(sends) <= 3
     total = sum(s.sum() for s in sends)
     assert total == pytest.approx(8 * 4)
+
+
+def test_hdfs_client_shells_out(tmp_path, monkeypatch):
+    """HDFSClient drives `hadoop fs` like the reference — verified against
+    a stub hadoop binary recording its argv."""
+    from paddle_tpu.distributed.fleet.util import HDFSClient
+    bin_dir = tmp_path / "hadoop" / "bin"
+    bin_dir.mkdir(parents=True)
+    log = tmp_path / "calls.log"
+    stub = bin_dir / "hadoop"
+    stub.write_text(
+        "#!/bin/sh\n"
+        f"echo \"$@\" >> {log}\n"
+        "case \"$*\" in\n"
+        "  *'-test -e /exists'*) exit 0;;\n"
+        "  *'-test'*) exit 1;;\n"
+        "  *'-ls'*) echo 'drwxr-xr-x - u g 0 2026-01-01 00:00 /data/sub';"
+        " echo '-rw-r--r-- 1 u g 9 2026-01-01 00:00 /data/a.txt'; exit 0;;\n"
+        "  *) exit 0;;\n"
+        "esac\n")
+    stub.chmod(0o755)
+    fs = HDFSClient(hadoop_home=str(tmp_path / "hadoop"),
+                    configs={"fs.default.name": "hdfs://nn:9000"})
+    assert fs.is_exist("/exists")
+    assert not fs.is_exist("/missing")
+    dirs, files = fs.ls_dir("/data")
+    assert dirs == ["sub"] and files == ["a.txt"]
+    fs.mkdirs("/data/new")
+    calls = log.read_text()
+    assert "-D fs.default.name=hdfs://nn:9000" in calls
+    assert "-mkdir -p /data/new" in calls
+    # missing binary -> clear error, not FileNotFoundError leakage
+    import pytest as _pytest
+    bad = HDFSClient(hadoop_home=str(tmp_path / "nope"))
+    with _pytest.raises(RuntimeError, match="hadoop binary"):
+        bad.is_exist("/x")
