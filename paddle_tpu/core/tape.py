@@ -93,15 +93,18 @@ class Node:
     """One recorded op: holds the vjp closure and edges to differentiable inputs."""
 
     __slots__ = ("vjp_fn", "inputs", "out_avals", "seq", "name", "multi_out",
-                 "out_hooks", "__weakref__")
+                 "out_hooks", "closed_fn", "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, out_avals, name, multi_out):
+    def __init__(self, vjp_fn, inputs, out_avals, name, multi_out,
+                 closed_fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs          # list[Tensor] — differentiable inputs only
         self.out_avals = out_avals    # list[(shape, dtype)]
         self.name = name
         self.multi_out = multi_out
         self.out_hooks = None         # {out_index: [hook]} via register_hook
+        self.closed_fn = closed_fn    # primal fn over diff inputs — lets
+                                      # create_graph re-derive a RECORDED vjp
         with _seq_lock:
             _seq_counter[0] += 1
             self.seq = _seq_counter[0]
@@ -188,7 +191,8 @@ def record_op(fn: Callable, args: Sequence[Any], kwargs: dict, name: str = None)
     outs = list(out_val) if multi_out else [out_val]
     out_avals = [(tuple(o.shape), o.dtype) for o in outs]
     node = Node(vjp_fn, [flat[i] for i in diff_idx], out_avals,
-                name or getattr(fn, "__name__", "op"), multi_out)
+                name or getattr(fn, "__name__", "op"), multi_out,
+                closed_fn=closed)
     return _wrap_outputs(out_val, node=node, stop_gradient=False)
 
 
@@ -427,10 +431,6 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     import jax.numpy as jnp
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double backward): use paddle_tpu.incubate."
-            "functional (jax.grad composition) for higher-order derivatives")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -442,6 +442,37 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         seeds.append((o, g))
 
     capture = {id(t): None for t in inputs}
+    if create_graph:
+        # recorded backward: gradients come out tape-linked (Tensors) and
+        # the primal graph is left intact (vjp closures untouched) —
+        # retain implied
+        leaf_grads = _run_engine_recorded(seeds, capture=capture)
+        results = []
+        for t in inputs:
+            seed_g = None
+            for o, gg in seeds:
+                if o is t:
+                    seed_g = gg if seed_g is None else seed_g + gg
+            if t._node is None:
+                gval = leaf_grads.get(id(t))
+                if gval is None:
+                    gval = capture[id(t)]
+            else:
+                gval = capture[id(t)]
+            if seed_g is not None:
+                seed_t = Tensor(seed_g, stop_gradient=True, _internal=True)
+                gval = seed_t if gval is None else record_op(
+                    jnp.add, (gval, seed_t), {}, name="grad_accumulate")
+            if gval is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the inputs was not used in the graph "
+                        "(pass allow_unused=True to get None)")
+                results.append(None)
+                continue
+            results.append(gval)
+        return results
+
     retain = bool(retain_graph) if retain_graph is not None else False
     leaf_grads = _run_engine(seeds, capture=capture, retain_graph=retain)
 
@@ -472,3 +503,106 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         else:
             results.append(Tensor(gval, stop_gradient=True, _internal=True))
     return results
+
+
+def _run_engine_recorded(seeds, capture=None):
+    """create_graph backward: same reverse-topological sweep as
+    _run_engine, but every node's vjp is RE-DERIVED from its stored primal
+    closure inside record_op — so the produced gradients are themselves
+    tape-linked Tensors and differentiate again (the reference's
+    imperative partial_grad_engine create_graph mode; double backward for
+    gradient penalties etc.). Costs one extra forward per node, the
+    standard price of re-execution-based higher-order autodiff."""
+    import jax.numpy as jnp
+
+    from .tensor import Tensor
+
+    def as_tensor(v):
+        return v if isinstance(v, Tensor) else Tensor(v, stop_gradient=True,
+                                                      _internal=True)
+
+    def add_t(a, b):
+        return record_op(jnp.add, (a, b), {}, name="grad_accumulate")
+
+    cot = {}
+    leaf_grads = {}
+
+    def seed_tensor(t, g):
+        g = as_tensor(g)
+        if t._node is None:
+            key = id(t)
+            leaf_grads[key] = g if key not in leaf_grads \
+                else add_t(leaf_grads[key], g)
+        else:
+            k = (id(t._node), t._out_index)
+            cot[k] = g if k not in cot else add_t(cot[k], g)
+
+    for t, g in seeds:
+        seed_tensor(t, g)
+
+    seen = set()
+    stack = [t._node for t, _ in seeds if t._node is not None]
+    order = []
+    while stack:
+        n = stack.pop()
+        if n is None or id(n) in seen:
+            continue
+        seen.add(id(n))
+        order.append(n)
+        for inp in n.inputs:
+            if inp._node is not None:
+                stack.append(inp._node)
+    order.sort(key=lambda n: n.seq, reverse=True)
+
+    for n in order:
+        outs_cot = [cot.pop((id(n), i), None)
+                    for i in range(len(n.out_avals))]
+        if all(c is None for c in outs_cot):
+            continue
+        if n.closed_fn is None:
+            raise RuntimeError(
+                f"create_graph backward through op '{n.name}' which has no "
+                "re-derivable primal (graph built before this feature?)")
+        full = [c if c is not None
+                else Tensor(jnp.zeros(n.out_avals[i][0],
+                                      n.out_avals[i][1]),
+                            stop_gradient=True, _internal=True)
+                for i, c in enumerate(outs_cot)]
+        if n.out_hooks:
+            for i, hooks in n.out_hooks.items():
+                for h in hooks:
+                    res = h(full[i])
+                    if res is not None:
+                        full[i] = as_tensor(res)
+
+        k = len(n.inputs)
+
+        def bwd(*vals, _closed=n.closed_fn, _multi=n.multi_out, _k=k):
+            prim, cots = vals[:_k], vals[_k:]
+            _, vjp = jax.vjp(_closed, *prim)
+            arg = tuple(cots) if _multi else cots[0]
+            return tuple(vjp(arg))
+
+        in_cots = record_op(bwd, (*n.inputs, *full), {},
+                            name=n.name + "_grad")
+        in_cots = in_cots if isinstance(in_cots, (tuple, list)) \
+            else [in_cots]
+        for inp, g in zip(n.inputs, in_cots):
+            gv = g._value if isinstance(g, Tensor) else g
+            if isinstance(gv, np.ndarray) and gv.dtype == jax.dtypes.float0:
+                continue
+            if g is None or inp.stop_gradient:
+                continue
+            g = as_tensor(g)
+            if inp._node is not None:
+                key = (id(inp._node), inp._out_index)
+                cot[key] = g if key not in cot else add_t(cot[key], g)
+            else:
+                key = id(inp)
+                leaf_grads[key] = g if key not in leaf_grads \
+                    else add_t(leaf_grads[key], g)
+            if capture is not None and id(inp) in capture:
+                capture[id(inp)] = (g if capture[id(inp)] is None
+                                    else add_t(capture[id(inp)], g))
+
+    return leaf_grads
