@@ -324,6 +324,18 @@ def bench_bert():
     flops_per_step = 6 * n_params * tokens + attn_flops
     mfu = flops_per_step * steps_per_sec / PEAK_FLOPS
 
+    # which Pallas kernels are actually in this graph: fused CE always
+    # (vocab head), flash attention only when SEQ clears the measured
+    # profitability threshold (FLAGS_flash_min_seq; XLA's fused attention
+    # wins below it — see nn/functional._flash_eligible)
+    from paddle_tpu.core import flags as _flags
+    kernels = []
+    if not pallas_fallback:
+        if _flags.flag("FLAGS_use_fused_ce"):
+            kernels.append("fused_ce")
+        min_seq = int(_flags.flag("FLAGS_flash_min_seq") or 0)
+        if _flags.flag("FLAGS_use_flash_attention") and                 (not min_seq or SEQ >= min_seq):
+            kernels.append("flash_attention")
     result = {
         "metric": f"bert_base_mlm_train_b{BATCH}_s{SEQ}_{DTYPE}",
         "value": round(samples_per_sec, 2),
@@ -336,6 +348,7 @@ def bench_bert():
         "params": n_params,
         "steps": STEPS,
         "pallas_fallback": pallas_fallback,
+        "pallas_kernels_in_graph": kernels,
     }
     print(json.dumps(result))
 
