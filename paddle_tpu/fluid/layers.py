@@ -38,6 +38,17 @@ def _layer_cached(key, build):
     return layer
 
 
+def _auto_name(prefix, name):
+    """Unnamed v1 layer calls create FRESH parameters per call, named by
+    the global unique_name generator exactly like the reference's
+    LayerHelper (two anonymous fc() calls are fc_0/fc_1, never shared);
+    an explicit name pins and reuses the layer across rebuilds."""
+    if name is not None:
+        return name
+    from ..utils import unique_name
+    return unique_name.generate(prefix)
+
+
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,  # noqa: A002
        act=None, name=None):
     """reference fluid/layers/nn.py:181. Flattens trailing dims, applies a
@@ -46,6 +57,7 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,  # noqa
     in_dim = int(np.prod(x.shape[num_flatten_dims:]))
     if len(x.shape) > num_flatten_dims + 1:
         x = ops.reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim])
+    name = _auto_name("fc", name)
     layer = _layer_cached(("fc", name, in_dim, size), lambda: _nn.Linear(
         in_dim, size, weight_attr=param_attr, bias_attr=bias_attr))
     out = layer(x)
@@ -56,6 +68,7 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,  # noqa
 
 def embedding(input, size, is_sparse=False, padding_idx=None,  # noqa: A002
               param_attr=None, dtype="float32", name=None):
+    name = _auto_name("embedding", name)
     layer = _layer_cached(("emb", name, tuple(size)), lambda: _nn.Embedding(
         size[0], size[1], padding_idx=padding_idx, sparse=is_sparse,
         weight_attr=param_attr))
@@ -66,6 +79,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
            dilation=1, groups=1, param_attr=None, bias_attr=None, act=None,
            name=None):
     cin = input.shape[1]
+    name = _auto_name("conv2d", name)
     layer = _layer_cached(
         ("conv2d", name, cin, num_filters, filter_size),
         lambda: _nn.Conv2D(cin, num_filters, filter_size, stride=stride,
@@ -94,6 +108,7 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,  # noqa: A002
                param_attr=None, bias_attr=None, data_layout="NCHW",
                is_test=False, name=None):
     c = input.shape[1]
+    name = _auto_name("batch_norm", name)
     layer = _layer_cached(("bn", name, c), lambda: _nn.BatchNorm(
         c, momentum=momentum, epsilon=epsilon, weight_attr=param_attr,
         bias_attr=bias_attr, data_format=data_layout))

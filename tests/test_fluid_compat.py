@@ -85,3 +85,18 @@ def test_fluid_io_roundtrip(tmp_path):
         np.testing.assert_allclose(before, after)
     finally:
         paddle.disable_static()
+
+
+def test_unnamed_layers_do_not_share_params():
+    """Two anonymous fc() calls create distinct parameters (reference
+    LayerHelper auto-names fc_0/fc_1); explicit names pin reuse."""
+    fluid.layers._param_layers.clear()
+    rng = np.random.RandomState(3)
+    x = paddle.to_tensor(rng.rand(2, 4).astype("float32"))
+    a = fluid.layers.fc(x, size=3)
+    b = fluid.layers.fc(x, size=3)
+    assert not np.allclose(a.numpy(), b.numpy()), \
+        "anonymous fc calls shared parameters"
+    c1 = fluid.layers.fc(x, size=3, name="pinned")
+    c2 = fluid.layers.fc(x, size=3, name="pinned")
+    np.testing.assert_allclose(c1.numpy(), c2.numpy())
