@@ -1,0 +1,23 @@
+"""paddle.utils.dlpack — zero-copy tensor interchange (reference
+python/paddle/utils/dlpack.py to_dlpack/from_dlpack over the DLPack
+protocol). jax arrays speak __dlpack__ natively, so interop with torch/
+numpy/cupy is direct."""
+from __future__ import annotations
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Tensor -> DLPack capsule."""
+    from ..core.tensor import Tensor
+    v = x._value if isinstance(x, Tensor) else x
+    return v.__dlpack__()
+
+
+def from_dlpack(capsule_or_tensor):
+    """DLPack capsule (or any object with __dlpack__, e.g. a torch
+    tensor) -> Tensor."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    return Tensor(jnp.from_dlpack(capsule_or_tensor), _internal=True)

@@ -148,3 +148,20 @@ def test_metric_chunk_evaluator_and_edit_distance():
     ed.update([[1, 2, 3]], [[1, 3]])
     ed.update([[4]], [[4]])
     assert ed.accumulate() == 0.5          # (1 + 0) / 2
+
+
+def test_dlpack_interop_with_torch():
+    """utils.dlpack (reference paddle/utils/dlpack.py): zero-copy exchange
+    with torch over the DLPack protocol."""
+    import torch as _torch
+
+    import paddle_tpu as paddle
+    from paddle_tpu.utils import dlpack
+
+    t = _torch.arange(6, dtype=_torch.float32).reshape(2, 3)
+    pt = dlpack.from_dlpack(t)
+    np.testing.assert_allclose(pt.numpy(), t.numpy())
+
+    x = paddle.to_tensor(np.ones((3, 2), "float32") * 7)
+    back = _torch.utils.dlpack.from_dlpack(dlpack.to_dlpack(x))
+    np.testing.assert_allclose(back.numpy(), 7.0)
