@@ -893,3 +893,75 @@ def auc(predict, label, num_thresholds=200):
     # tie-break underflows and leaves diagonal artifacts
     order = jnp.lexsort((tpr, fpr))
     return jnp.trapezoid(tpr[order], fpr[order])
+
+
+__all__ += ["py_func"]
+
+
+def py_func(func, x, out_shapes=None, out_dtypes="float32",
+            backward_func=None):
+    """Host-Python op inside compiled graphs (reference
+    operators/py_func_op.cc + fluid/layers/nn.py py_func): the callable
+    runs on the HOST each step via jax.pure_callback — XLA inserts the
+    device<->host transfer, so this composes with jit/static Programs
+    (the reference's escape hatch for ops without kernels).
+
+    func: numpy-in/numpy-out callable; x: Tensor or list of Tensors;
+    out_shapes/out_dtypes: result specs (default: same as first input).
+    backward_func: optional numpy grad callable (inputs..., grad_out) ->
+    grads tuple, wired through jax.custom_vjp (itself a callback)."""
+    import numpy as _np
+
+    from ..core.dtype import to_jax_dtype
+    from ..core.tensor import Tensor
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    vals = [v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            for v in xs]
+    if out_shapes is None:
+        out_shapes = [tuple(vals[0].shape)]
+        single = True
+    else:
+        single = not isinstance(out_shapes[0], (list, tuple))
+        out_shapes = [tuple(out_shapes)] if single \
+            else [tuple(s) for s in out_shapes]
+    if isinstance(out_dtypes, str):
+        out_dtypes = [out_dtypes] * len(out_shapes)
+    specs = [jax.ShapeDtypeStruct(s, to_jax_dtype(d))
+             for s, d in zip(out_shapes, out_dtypes)]
+
+    def host(*arrs):
+        out = func(*[_np.asarray(a) for a in arrs])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(_np.asarray(o, spec.dtype)
+                     for o, spec in zip(outs, specs))
+
+    def call(*vals_):
+        res = jax.pure_callback(host, tuple(specs), *vals_)
+        return res[0] if single else tuple(res)
+
+    if backward_func is not None:
+        in_specs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in vals]
+
+        def bwd_host(*args):
+            grads = backward_func(*[_np.asarray(a) for a in args])
+            gs = grads if isinstance(grads, (list, tuple)) else [grads]
+            return tuple(_np.asarray(g, s.dtype)
+                         for g, s in zip(gs, in_specs))
+
+        call_vjp = jax.custom_vjp(call)
+
+        def fwd(*vals_):
+            return call(*vals_), vals_
+
+        def bwd(res, g):
+            gouts = g if isinstance(g, (tuple, list)) else (g,)
+            return jax.pure_callback(bwd_host, tuple(in_specs),
+                                     *res, *gouts)
+
+        call_vjp.defvjp(fwd, bwd)
+        call = call_vjp
+
+    from ._dispatch import defop
+    op = defop(call, name="py_func_call")
+    return op(*xs)

@@ -165,3 +165,39 @@ def test_dlpack_interop_with_torch():
     x = paddle.to_tensor(np.ones((3, 2), "float32") * 7)
     back = _torch.utils.dlpack.from_dlpack(dlpack.to_dlpack(x))
     np.testing.assert_allclose(back.numpy(), 7.0)
+
+
+def test_py_func_host_callback_in_jit_and_grad():
+    """ops.py_func (reference py_func_op.cc): host numpy code inside the
+    compiled step via pure_callback, with a custom backward."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import ops
+
+    def host_fn(a):
+        return np.sin(a) * 2.0
+
+    def host_bwd(a, g):
+        return (np.cos(a) * 2.0 * g,)
+
+    x = paddle.to_tensor(np.array([0.0, 1.0, 2.0], "float32"),
+                         stop_gradient=False)
+    out = ops.py_func(host_fn, x, backward_func=host_bwd)
+    np.testing.assert_allclose(out.numpy(), np.sin(x.numpy()) * 2.0,
+                               rtol=1e-6)
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               np.cos(x.numpy()) * 2.0, rtol=1e-5)
+
+    # composes under jit (XLA inserts the host round-trip)
+    from paddle_tpu.core.tensor import Tensor
+
+    @jax.jit
+    def f(v):
+        return ops.py_func(host_fn, Tensor(v, _internal=True))._value
+
+    np.testing.assert_allclose(
+        np.asarray(f(jnp.arange(3, dtype=jnp.float32))),
+        np.sin([0, 1, 2]) * 2, rtol=1e-5)
