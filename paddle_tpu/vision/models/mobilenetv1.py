@@ -61,4 +61,8 @@ class MobileNetV1(nn.Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV1(scale=scale, **kwargs)
+    net = MobileNetV1(scale=scale, **kwargs)
+    if pretrained:
+        from .resnet import _load_pretrained
+        _load_pretrained(net, f"mobilenetv1_{scale}")
+    return net

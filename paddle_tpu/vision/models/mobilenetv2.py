@@ -84,4 +84,8 @@ class MobileNetV2(nn.Layer):
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV2(scale=scale, **kwargs)
+    net = MobileNetV2(scale=scale, **kwargs)
+    if pretrained:
+        from .resnet import _load_pretrained
+        _load_pretrained(net, f"mobilenetv2_{scale}")
+    return net

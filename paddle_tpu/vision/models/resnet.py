@@ -122,28 +122,49 @@ class ResNet(nn.Layer):
         return x
 
 
-def _resnet(block, depth, width=64, **kwargs):
-    return ResNet(block, depth, width=width, **kwargs)
+def _load_pretrained(net, arch):
+    """Load cached pretrained weights (utils.download zero-egress
+    contract): {cache}/[arch].pdparams saved by paddle_tpu.save; raises
+    with the drop-in path when absent — never silently random-init."""
+    import os
+
+    from ...framework.io import load as _load
+    from ...utils.download import weights_cache_dir
+    path = os.path.join(weights_cache_dir(), f"{arch}.pdparams")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"pretrained={arch!r} weights not found at {path}; paddle_tpu "
+            "is zero-egress — convert/place them there first "
+            "(paddle_tpu.save(state_dict, path))")
+    net.set_state_dict(_load(path))
+    return net
+
+
+def _resnet(block, depth, width=64, pretrained=False, arch=None, **kwargs):
+    net = ResNet(block, depth, width=width, **kwargs)
+    if pretrained:
+        _load_pretrained(net, arch or f"resnet{depth}")
+    return net
 
 
 def resnet18(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 18, **kwargs)
+    return _resnet(BasicBlock, 18, pretrained=pretrained, **kwargs)
 
 
 def resnet34(pretrained=False, **kwargs):
-    return _resnet(BasicBlock, 34, **kwargs)
+    return _resnet(BasicBlock, 34, pretrained=pretrained, **kwargs)
 
 
 def resnet50(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 50, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained=pretrained, **kwargs)
 
 
 def resnet101(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 101, **kwargs)
+    return _resnet(BottleneckBlock, 101, pretrained=pretrained, **kwargs)
 
 
 def resnet152(pretrained=False, **kwargs):
-    return _resnet(BottleneckBlock, 152, **kwargs)
+    return _resnet(BottleneckBlock, 152, pretrained=pretrained, **kwargs)
 
 
 def wide_resnet50_2(pretrained=False, **kwargs):

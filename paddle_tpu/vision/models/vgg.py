@@ -61,16 +61,32 @@ def _vgg(cfg, batch_norm=False, **kwargs):
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("A", batch_norm, **kwargs)
+    net = _vgg("A", batch_norm, **kwargs)
+    if pretrained:
+        from .resnet import _load_pretrained
+        _load_pretrained(net, "vgg11" + ("_bn" if batch_norm else ""))
+    return net
 
 
 def vgg13(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("B", batch_norm, **kwargs)
+    net = _vgg("B", batch_norm, **kwargs)
+    if pretrained:
+        from .resnet import _load_pretrained
+        _load_pretrained(net, "vgg13" + ("_bn" if batch_norm else ""))
+    return net
 
 
 def vgg16(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("D", batch_norm, **kwargs)
+    net = _vgg("D", batch_norm, **kwargs)
+    if pretrained:
+        from .resnet import _load_pretrained
+        _load_pretrained(net, "vgg16" + ("_bn" if batch_norm else ""))
+    return net
 
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
-    return _vgg("E", batch_norm, **kwargs)
+    net = _vgg("E", batch_norm, **kwargs)
+    if pretrained:
+        from .resnet import _load_pretrained
+        _load_pretrained(net, "vgg19" + ("_bn" if batch_norm else ""))
+    return net

@@ -88,3 +88,25 @@ def test_voc2012(tmp_path):
     img, lab = ds[0]
     assert img.shape == (9, 12, 3)
     assert lab.shape == (9, 12)
+
+
+def test_pretrained_loads_from_cache_or_raises(tmp_path, monkeypatch):
+    """pretrained=True resolves weights from the zero-egress cache and
+    raises with the drop-in path when absent (was silently ignored)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet18
+
+    monkeypatch.setenv("PADDLE_TPU_WEIGHTS_DIR", str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="resnet18"):
+        resnet18(pretrained=True)
+
+    paddle.seed(0)
+    donor = resnet18()
+    from paddle_tpu.framework.io import save as fsave
+    fsave(donor.state_dict(), str(tmp_path / "resnet18.pdparams"))
+    loaded = resnet18(pretrained=True)
+    a = dict(donor.named_parameters())
+    b = dict(loaded.named_parameters())
+    k = next(iter(a))
+    np.testing.assert_allclose(np.asarray(a[k]._value),
+                               np.asarray(b[k]._value))
