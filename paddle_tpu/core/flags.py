@@ -81,6 +81,10 @@ define_flag("FLAGS_flash_block_q", 0,
             "128)")
 define_flag("FLAGS_flash_block_k", 0,
             "flash attention k block size (0 = auto)")
+define_flag("FLAGS_fused_ce_block_n", 0,
+            "fused CE token-block size (0 = auto 512)")
+define_flag("FLAGS_fused_ce_block_v", 0,
+            "fused CE vocab-block size (0 = auto 512)")
 define_flag("FLAGS_flash_attention_interpret", False,
             "also use the flash kernel off-TPU via the Pallas interpreter "
             "(slow; for tests)")

@@ -26,7 +26,7 @@ from .flash_attention import NEG_INF, _Z, _cparams, _interpret, _vmem
 
 
 def _pick(n, target):
-    for b in (target, 512, 256, 128, 64, 32, 16, 8):
+    for b in (target, 1024, 512, 256, 128, 64, 32, 16, 8):
         if b <= target and n % b == 0:
             return b
     return None
@@ -296,12 +296,16 @@ def fused_linear_cross_entropy(hidden, weight, bias, labels,
     bias: [vocab] or None; labels: [n] int. Returns f32 [n] losses, 0 where
     labels == ignore_index. Reduce (mean over valid) in the caller.
     """
+    from ...core import flags as _flags
     n, hd = hidden.shape
     vocab = weight.shape[0]
-    bn = _pick(n, 512)
+    bn_target = int(_flags.flag("FLAGS_fused_ce_block_n") or 0) or 512
+    bn = _pick(n, bn_target)
     if bn is None:
         raise ValueError(f"fused CE: n_tokens {n} has no block factor")
-    bv = 512 if vocab >= 512 else max(8, 1 << (vocab - 1).bit_length() >> 1)
+    bv_cfg = int(_flags.flag("FLAGS_fused_ce_block_v") or 0)
+    bv = bv_cfg or (512 if vocab >= 512
+                    else max(8, 1 << (vocab - 1).bit_length() >> 1))
     labels = labels.astype(jnp.int32)
     return _fused_ce(hidden, weight, bias, labels, int(ignore_index),
                      bn, min(bv, vocab))
