@@ -363,7 +363,10 @@ def viterbi_decode(emission, transition, length=None):
 
     def backtrack(tag, bp_t):
         prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
-        return prev, tag
+        # emit the PREDECESSOR: bps[k] maps tag@k+1 -> tag@k, so the
+        # reverse scan's slot k must receive tag@k (emitting the carry
+        # dropped tag@0 and duplicated the final tag)
+        return prev, prev
 
     _, path_rev = jax.lax.scan(backtrack, last_tag, bps, reverse=True)
     paths = jnp.concatenate(

@@ -113,3 +113,107 @@ def test_huge_vocab_sharded_embedding_mesh8():
     want = host[np.asarray(ids)]
     np.testing.assert_allclose(np.asarray(out), want, atol=1e-6)
     mesh_mod.init_mesh({"dp": 8})
+
+
+def test_recommender_system_book(tmp_path):
+    """fluid 'book' recommender_system (reference
+    python/paddle/fluid/tests/book/test_recommender_system.py): user/movie
+    embeddings + fc towers + cosine ranking over MovieLens — here over the
+    zero-egress Movielens dataset and the 2.0 API."""
+    import zipfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.text.datasets import Movielens
+
+    users = "".join(f"{u}::M::25::4::1\n" for u in range(1, 5))
+    movies = "".join(f"{m}::T{m} (1995)::Comedy\n" for m in range(1, 6))
+    rng = np.random.RandomState(0)
+    ratings = "".join(
+        f"{rng.randint(1, 5)}::{rng.randint(1, 6)}::{rng.randint(1, 6)}::0\n"
+        for _ in range(64))
+    z = str(tmp_path / "ml.zip")
+    with zipfile.ZipFile(z, "w") as zf:
+        zf.writestr("ml-1m/users.dat", users)
+        zf.writestr("ml-1m/movies.dat", movies)
+        zf.writestr("ml-1m/ratings.dat", ratings)
+    ds = Movielens(data_file=z, mode="train", test_ratio=0.0)
+
+    paddle.seed(0)
+
+    class Tower(nn.Layer):
+        def __init__(self, n_ids):
+            super().__init__()
+            self.emb = nn.Embedding(n_ids, 8)
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids))
+
+    user_t, movie_t = Tower(8), Tower(8)
+    params = list(user_t.parameters()) + list(movie_t.parameters())
+    opt = optimizer.Adam(learning_rate=0.05, parameters=params)
+
+    uid = paddle.to_tensor(np.array([r[0] for r in ds], "int64"))
+    mid = paddle.to_tensor(np.array([r[4] for r in ds], "int64"))
+    rating = paddle.to_tensor(
+        np.array([r[7] for r in ds], "float32") / 5.0)
+
+    losses = []
+    for _ in range(30):
+        uu, mm = user_t(uid), movie_t(mid)
+        sim = paddle.ops.cos_sim(uu, mm)
+        loss = ((sim - rating) ** 2.0).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_label_semantic_roles_book(tmp_path):
+    """fluid 'book' label_semantic_roles (reference
+    book/test_label_semantic_roles.py): embeddings -> BiGRU-ish encoder ->
+    linear_chain_crf over Conll05 — viterbi decode recovers training
+    labels on a tiny corpus."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer, ops
+    from paddle_tpu.text.datasets import Conll05st
+
+    words = "The\ncat\nsat\n\nA\ndog\nbarked\n\nThe\ndog\nsat\n"
+    props = "- B-A0\n- I-A0\n- B-V\n\n- B-A0\n- I-A0\n- B-V\n\n" \
+            "- B-A0\n- I-A0\n- B-V\n"
+    wf, pf = tmp_path / "w.txt", tmp_path / "p.txt"
+    wf.write_text(words)
+    pf.write_text(props)
+    ds = Conll05st(words_file=str(wf), props_file=str(pf))
+    V, L = len(ds.word_dict), len(ds.label_dict)
+
+    paddle.seed(0)
+    emb = nn.Embedding(V, 16)
+    fc = nn.Linear(16, L)
+    # CRF transition params
+    import jax.numpy as jnp
+    trans = paddle.to_tensor(
+        np.zeros((L + 2, L), "float32"), stop_gradient=False)
+    params = list(emb.parameters()) + list(fc.parameters()) + [trans]
+    opt = optimizer.Adam(learning_rate=0.1, parameters=params)
+
+    seqs = [ds[i] for i in range(len(ds))]
+    for _ in range(60):
+        total = None
+        for w, lab in seqs:
+            feats = ops.unsqueeze(fc(emb(paddle.to_tensor(w))), [0])
+            nll = ops.linear_chain_crf(
+                feats, trans, paddle.to_tensor(lab[None], "int64"))
+            nll = nll.sum() if hasattr(nll, "sum") else nll
+            total = nll if total is None else total + nll
+        total.backward()
+        opt.step()
+        opt.clear_grad()
+    # decode recovers gold labels
+    for w, lab in seqs:
+        feats = ops.unsqueeze(fc(emb(paddle.to_tensor(w))), [0])
+        _, path = ops.viterbi_decode(feats, trans)
+        np.testing.assert_array_equal(
+            np.asarray(path._value).reshape(-1), lab)

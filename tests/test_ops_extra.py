@@ -296,6 +296,15 @@ def test_linear_chain_crf_vs_bruteforce():
     assert np.isfinite(np.asarray(e_t.grad._value)).all()
     assert np.isfinite(np.asarray(tr_t.grad._value)).all()
 
+    # regression: a NON-constant best path (random seeds above happened to
+    # have constant optima, which masked a backtrack emit bug that dropped
+    # tag0 and duplicated the final tag)
+    em2 = np.full((1, 3, 3), -5.0, "float32")
+    em2[0, 0, 0] = em2[0, 1, 1] = em2[0, 2, 2] = 5.0
+    t2 = np.zeros((5, 3), "float32")
+    _, p2 = ops.viterbi_decode(T(em2), T(t2))
+    np.testing.assert_array_equal(p2.numpy()[0], [0, 1, 2])
+
 
 def test_grid_sample_and_affine_grid_vs_torch():
     """Golden vs torch grid_sample/affine_grid (CPU torch implements the
