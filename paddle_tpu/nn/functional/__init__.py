@@ -229,3 +229,180 @@ def sequence_mask(lengths, maxlen=None, dtype="int64"):
     mask = jnp.arange(m)[None, :] < lv[..., None]
     from ...core.dtype import to_jax_dtype
     return wrap(mask.astype(to_jax_dtype(dtype)))
+
+
+# -- round-4: close the functional-surface gap vs the reference ------------
+# (python/paddle/nn/functional/__init__.py re-exports the v1 layer names
+# too; the implementations live in ops/ — re-export the done ones and
+# implement the remaining small kernels below)
+
+from ...ops.math_extra import (affine_grid, diag_embed, grid_sample,  # noqa: E402,F401
+                               bilinear_tensor_product, fsp_matrix,
+                               filter_by_instag, cvm as continuous_value_model,
+                               hash_bucket as hash,  # noqa: A004
+                               batch_fc, rank_attention,
+                               match_matrix_tensor, conv_shift,
+                               gru_unit, lstm_unit, accuracy, auc)
+from ...ops.detection import (anchor_generator, bipartite_match, box_clip,  # noqa: E402,F401
+                              box_coder, box_decoder_and_assign,
+                              collect_fpn_proposals, density_prior_box,
+                              distribute_fpn_proposals, iou_similarity,
+                              matrix_nms, mine_hard_examples,
+                              multiclass_nms, polygon_box_transform,
+                              prior_box, roi_align, roi_pool, target_assign,
+                              yolo_box, yolov3_loss)
+from ...ops.loss import (bpr_loss, center_loss, ctc_loss, hinge_loss,  # noqa: E402,F401
+                         hsigmoid_loss, linear_chain_crf, nce, npair_loss,
+                         rank_loss, sigmoid_focal_loss,
+                         teacher_student_sigmoid_loss,
+                         ctc_loss as warpctc, viterbi_decode)
+from ...ops.conv import (affine_channel, deform_conv2d,  # noqa: E402,F401
+                         deform_conv2d as deformable_conv, im2sequence,
+                         psroi_pool, random_crop, row_conv)
+from ...ops.norm_ops import data_norm, l2_normalize  # noqa: E402,F401
+from ...ops.manipulation import (pad2d, pad3d, pad_constant_like,  # noqa: E402,F401
+                                 shuffle_channel, space_to_depth,
+                                 temporal_shift)
+from ...ops import sequence as _seq  # noqa: E402
+from ...ops.sequence import (sequence_concat, sequence_conv,  # noqa: E402,F401
+                             sequence_enumerate, sequence_expand,
+                             sequence_expand_as, sequence_first_step,
+                             sequence_last_step, sequence_mask,
+                             sequence_pad, sequence_pool, sequence_reshape,
+                             sequence_reverse, sequence_scatter,
+                             sequence_slice, sequence_softmax,
+                             sequence_unpad)
+
+
+def image_resize(x, out_shape=None, scale=None, resample="BILINEAR",
+                 align_corners=True, data_format="NCHW"):
+    """v1 alias over interpolate (reference image_resize)."""
+    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+            "TRILINEAR": "trilinear"}[resample.upper()]
+    return interpolate(x, size=out_shape, scale_factor=scale, mode=mode,
+                       data_format=data_format)
+
+
+def resize_bilinear(x, out_shape=None, scale=None, **kw):
+    return image_resize(x, out_shape, scale, "BILINEAR")
+
+
+def resize_nearest(x, out_shape=None, scale=None, **kw):
+    return image_resize(x, out_shape, scale, "NEAREST")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False):
+    """reference pool_op.cc 1-D avg (squeeze-through-2D like max_pool1d)."""
+    from ... import ops as _ops
+    x4 = _ops.unsqueeze(x, [2])
+    k = (1, kernel_size if isinstance(kernel_size, int) else kernel_size[0])
+    s = (1, (stride if isinstance(stride, int) else
+             (stride[0] if stride else k[1])) or k[1])
+    p = (0, padding if isinstance(padding, int) else padding[0])
+    out = avg_pool2d(x4, k, stride=s, padding=p, ceil_mode=ceil_mode,
+                     exclusive=exclusive)
+    return _ops.squeeze(out, [2])
+
+
+def adaptive_avg_pool1d(x, output_size):
+    from ... import ops as _ops
+    x4 = _ops.unsqueeze(x, [2])
+    out = adaptive_avg_pool2d(x4, (1, output_size))
+    return _ops.squeeze(out, [2])
+
+
+def adaptive_max_pool1d(x, output_size):
+    from ... import ops as _ops
+    x4 = _ops.unsqueeze(x, [2])
+    out = adaptive_max_pool2d(x4, (1, output_size))
+    return _ops.squeeze(out, [2])
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    """SELU-preserving dropout (reference alpha_dropout): keeps mean/var
+    under the SELU fixed point by dropping to alpha' with affine fixup."""
+    if not training or p == 0.0:
+        return x
+    import jax
+
+    from ...core import rng as _rng
+    from ...core.tensor import Tensor
+    alpha_p = -1.7580993408473766
+    v = x._value if isinstance(x, Tensor) else x
+    keep = 1.0 - p
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    mask = jax.random.bernoulli(_rng.next_key(), keep, v.shape)
+    out = a * jnp.where(mask, v, alpha_p) + b
+    return Tensor(out.astype(v.dtype), _internal=True)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    """Channel-wise dropout (reference dropout_nd): zero whole feature
+    maps."""
+    if not training or p == 0.0:
+        return x
+    import jax
+
+    from ...core import rng as _rng
+    from ...core.tensor import Tensor
+    v = x._value if isinstance(x, Tensor) else x
+    shape = (v.shape[0], v.shape[1], 1, 1) if data_format == "NCHW" \
+        else (v.shape[0], 1, 1, v.shape[-1])
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_rng.next_key(), keep, shape)
+    return Tensor((jnp.where(mask, v, 0) / keep).astype(v.dtype),
+                  _internal=True)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    if not training or p == 0.0:
+        return x
+    import jax
+
+    from ...core import rng as _rng
+    from ...core.tensor import Tensor
+    v = x._value if isinstance(x, Tensor) else x
+    shape = (v.shape[0], v.shape[1], 1, 1, 1) if data_format == "NCDHW" \
+        else (v.shape[0], 1, 1, 1, v.shape[-1])
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_rng.next_key(), keep, shape)
+    return Tensor((jnp.where(mask, v, 0) / keep).astype(v.dtype),
+                  _internal=True)
+
+
+def dice_loss(input, label, epsilon=1e-5):  # noqa: A002
+    """reference dice_loss (fluid/layers/loss.py): 1 - 2|X∩Y|/(|X|+|Y|)
+    over the class axis (input [N, ..., C] probabilities, label ints)."""
+    from ... import ops as _ops
+    lab = _ops.one_hot(label.squeeze(-1) if label.shape[-1] == 1 else label,
+                       input.shape[-1]).astype(input.dtype)
+    reduce_dims = list(range(1, len(input.shape)))
+    inter = _ops.sum(input * lab, axis=reduce_dims)
+    union = _ops.sum(input, axis=reduce_dims) + _ops.sum(lab,
+                                                         axis=reduce_dims)
+    return _ops.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def soft_relu(x, threshold=40.0):
+    """reference soft_relu: log(1 + exp(clip(x)))."""
+    from ... import ops as _ops
+    return _ops.log1p(_ops.exp(_ops.clip(x, -threshold, threshold)))
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    """reference add_position_encoding_op.cc: sinusoidal PE added with
+    x*alpha + pe*beta; x [B, T, D]."""
+    from ...core.tensor import Tensor
+    v = x._value if isinstance(x, Tensor) else x
+    b, t, d = v.shape
+    half = d // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos / div[None, :]
+    pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=1)
+    if pe.shape[1] < d:
+        pe = jnp.pad(pe, ((0, 0), (0, d - pe.shape[1])))
+    out = alpha * v + beta * pe[None].astype(v.dtype)
+    return Tensor(out, _internal=True)

@@ -201,3 +201,58 @@ def test_py_func_host_callback_in_jit_and_grad():
     np.testing.assert_allclose(
         np.asarray(f(jnp.arange(3, dtype=jnp.float32))),
         np.sin([0, 1, 2]) * 2, rtol=1e-5)
+
+
+def test_new_functional_smalls():
+    """The round-4 nn.functional additions (dice_loss, alpha_dropout,
+    dropout2d/3d, 1-D pools, soft_relu, add_position_encoding,
+    image_resize aliases)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.RandomState(0)
+    # dice loss: perfect prediction -> ~0
+    lab = np.array([[0], [1]], "int64")
+    perfect = np.eye(2, dtype="float32")[lab[:, 0]]
+    dl = float(F.dice_loss(T(perfect), paddle.to_tensor(lab, "int64"))
+               .numpy())
+    assert dl < 1e-3
+    # soft_relu = softplus with clipping
+    x = T([-1.0, 0.0, 3.0])
+    np.testing.assert_allclose(F.soft_relu(x).numpy(),
+                               np.log1p(np.exp([-1.0, 0.0, 3.0])),
+                               rtol=1e-5)
+    # 1-D pools
+    seq = T(rng.randn(2, 3, 8))
+    assert F.avg_pool1d(seq, 2, stride=2).shape == (2, 3, 4)
+    assert F.adaptive_avg_pool1d(seq, 2).shape == (2, 3, 2)
+    assert F.adaptive_max_pool1d(seq, 4).shape == (2, 3, 4)
+    # dropout2d zeroes whole channels; eval mode is identity
+    img = T(np.ones((2, 4, 5, 5)))
+    paddle.seed(7)
+    out = F.dropout2d(img, p=0.5, training=True).numpy()
+    per_chan = out.reshape(2, 4, -1)
+    assert set(np.unique((per_chan > 0).mean(axis=2))) <= {0.0, 1.0}
+    np.testing.assert_allclose(
+        F.dropout2d(img, p=0.5, training=False).numpy(), 1.0)
+    paddle.seed(8)
+    out3 = F.dropout3d(T(np.ones((1, 3, 2, 2, 2))), p=0.5).numpy()
+    assert out3.shape == (1, 3, 2, 2, 2)
+    # alpha_dropout preserves mean/std approximately on SELU-scale data
+    paddle.seed(9)
+    big = T(rng.randn(20000).astype("float32"))
+    ad = F.alpha_dropout(big, p=0.3).numpy()
+    assert abs(ad.mean()) < 0.1 and abs(ad.std() - 1.0) < 0.15
+    # positional encoding: beta=0 is identity; known sin at pos 1
+    xb = T(rng.randn(1, 4, 6))
+    np.testing.assert_allclose(
+        F.add_position_encoding(xb, beta=0.0).numpy(), xb.numpy(),
+        rtol=1e-6)
+    pe_only = F.add_position_encoding(T(np.zeros((1, 4, 6))),
+                                      alpha=0.0).numpy()
+    np.testing.assert_allclose(pe_only[0, 0, :3], 0.0, atol=1e-6)
+    np.testing.assert_allclose(pe_only[0, 1, 0], np.sin(1.0), rtol=1e-5)
+    # resize aliases
+    img2 = T(np.ones((1, 1, 4, 4)))
+    assert F.resize_nearest(img2, out_shape=(8, 8)).shape == (1, 1, 8, 8)
+    assert F.image_resize(img2, out_shape=(2, 2)).shape == (1, 1, 2, 2)
