@@ -451,7 +451,32 @@ def bench_longseq():
     }), flush=True)
 
 
+def _probe_backend(timeout_s):
+    """Fail fast when the TPU tunnel is wedged (init can hang forever on a
+    stale pool lease): probe jax.devices() in a thread; on timeout, emit a
+    diagnostic JSON line and exit nonzero instead of hanging the driver."""
+    import threading
+    done = {}
+
+    def probe():
+        import jax
+        done["devices"] = [str(d) for d in jax.devices()]
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" not in done:
+        print(json.dumps({
+            "metric": "bench_error", "value": 0, "unit": "none",
+            "vs_baseline": 0,
+            "error": f"jax backend init did not complete in {timeout_s}s "
+                     "(TPU tunnel unreachable)"}), flush=True)
+        os._exit(3)
+    print(f"# devices: {done['devices']}", file=sys.stderr, flush=True)
+
+
 def main():
+    _probe_backend(float(os.environ.get("BENCH_INIT_TIMEOUT", 600)))
     mode = os.environ.get("BENCH_MODE", "all")
     if mode in ("bert", "all"):
         bench_bert()          # flagship: FIRST stdout line
