@@ -264,10 +264,12 @@ from ...ops.manipulation import (pad2d, pad3d, pad_constant_like,  # noqa: E402,
                                  shuffle_channel, space_to_depth,
                                  temporal_shift)
 from ...ops import sequence as _seq  # noqa: E402
+# NB: F.sequence_mask stays the jit-aware version defined above — the
+# ops.sequence one is eager/RaggedTensor-oriented
 from ...ops.sequence import (sequence_concat, sequence_conv,  # noqa: E402,F401
                              sequence_enumerate, sequence_expand,
                              sequence_expand_as, sequence_first_step,
-                             sequence_last_step, sequence_mask,
+                             sequence_last_step,
                              sequence_pad, sequence_pool, sequence_reshape,
                              sequence_reverse, sequence_scatter,
                              sequence_slice, sequence_softmax,
