@@ -59,6 +59,13 @@ _SCRIPT = textwrap.dedent("""
     with open(os.path.join(workdir, f"ok_{rank}_{attempt}"), "w") as f:
         f.write("done")
     if rank == 0:
+        # wait for the peer before shutting servers down — stopping while
+        # rank 1 is mid-push would fail its RPC and flap the job
+        import time
+        peer = os.path.join(workdir, f"ok_1_{attempt}")
+        deadline = time.time() + 60
+        while not os.path.exists(peer) and time.time() < deadline:
+            time.sleep(0.1)
         client.stop_servers()
     client.close()
     sys.exit(0)
