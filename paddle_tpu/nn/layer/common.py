@@ -11,7 +11,8 @@ from .layers import Layer
 __all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
            "AlphaDropout", "Flatten", "Pad1D", "Pad2D", "Pad3D", "Upsample",
            "UpsamplingBilinear2D", "UpsamplingNearest2D", "Identity",
-           "Bilinear", "CosineSimilarity", "PixelShuffle", "Unfold"]
+           "Bilinear", "CosineSimilarity", "PixelShuffle", "Unfold",
+           "BilinearTensorProduct", "PairwiseDistance", "RowConv"]
 
 
 class Identity(Layer):
@@ -208,3 +209,56 @@ class Unfold(Layer):
 
     def forward(self, x):
         return F.unfold(x, *self.args)
+
+
+class BilinearTensorProduct(Layer):
+    """reference nn/layer/common.py BilinearTensorProduct over
+    ops.bilinear_tensor_product (x W_k y^T per output k)."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from .. import initializer as I
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([output_dim], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x1, x2):
+        from ... import ops
+        return ops.bilinear_tensor_product(x1, x2, self.weight, self.bias)
+
+
+class PairwiseDistance(Layer):
+    """reference nn/layer/distance.py PairwiseDistance (p-norm of x-y)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ... import ops
+        d = ops.abs(ops.add(x, ops.scale(y, -1.0)))
+        d = ops.add(d, ops.full_like(d, self.epsilon))
+        return ops.norm(d, p=self.p, axis=-1, keepdim=self.keepdim) \
+            if hasattr(ops, "norm") else ops.pow(
+                ops.sum(ops.pow(d, self.p), axis=-1,
+                        keepdim=self.keepdim), 1.0 / self.p)
+
+
+class RowConv(Layer):
+    """reference fluid RowConv (DeepSpeech lookahead) over ops.row_conv."""
+
+    def __init__(self, num_channels, future_context_size, param_attr=None):
+        super().__init__()
+        from .. import initializer as I
+        self.weight = self.create_parameter(
+            [future_context_size + 1, num_channels], attr=param_attr,
+            default_initializer=I.XavierNormal())
+
+    def forward(self, x):
+        from ... import ops
+        return ops.row_conv(x, self.weight)

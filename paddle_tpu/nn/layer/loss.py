@@ -7,7 +7,8 @@ from .layers import Layer
 __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "HuberLoss",
            "MarginRankingLoss", "HingeEmbeddingLoss", "TripletMarginLoss",
-           "CosineEmbeddingLoss", "CTCLoss"]
+           "CosineEmbeddingLoss", "CTCLoss",
+           "HSigmoidLoss", "NCELoss"]
 
 
 class CTCLoss(Layer):
@@ -185,3 +186,55 @@ class CosineEmbeddingLoss(Layer):
         if self.reduction == "sum":
             return ops.sum(loss)
         return loss
+
+
+class HSigmoidLoss(Layer):
+    """reference nn/layer/loss.py HSigmoidLoss over ops.hsigmoid_loss
+    (default complete-binary-tree paths)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_classes - 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label):  # noqa: A002
+        from ... import ops
+        return ops.hsigmoid_loss(input, label, self.weight, self.bias,
+                                 num_classes=self.num_classes)
+
+
+class NCELoss(Layer):
+    """NCE loss layer over ops.nce (host-sampled negatives passed per
+    call; reference fluid/dygraph NCE)."""
+
+    def __init__(self, num_total_classes, dim, num_neg_samples=5,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.num_total_classes = num_total_classes
+        self.num_neg_samples = num_neg_samples
+        self.weight = self.create_parameter(
+            [num_total_classes, dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_total_classes], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, sample_ids=None):  # noqa: A002
+        import numpy as _np
+
+        from ... import ops, to_tensor
+        if sample_ids is None:
+            sample_ids = to_tensor(_np.random.randint(
+                0, self.num_total_classes,
+                self.num_neg_samples).astype("int64"))
+        return ops.nce(input, label, self.weight, self.bias,
+                       sample_ids=sample_ids,
+                       num_neg_samples=self.num_neg_samples,
+                       num_total_classes=self.num_total_classes)

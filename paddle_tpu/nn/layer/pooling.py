@@ -109,3 +109,69 @@ class AdaptiveMaxPool2D(Layer):
 
     def forward(self, x):
         return F.adaptive_max_pool2d(x, self.output_size)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class Pool2D(Layer):
+    """fluid-era pooling layer (reference fluid/dygraph/nn.py Pool2D)."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False,
+                 exclusive=True, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = dict(pool_size=pool_size, pool_type=pool_type,
+                         pool_stride=pool_stride, pool_padding=pool_padding,
+                         global_pooling=global_pooling, ceil_mode=ceil_mode)
+        self.exclusive = exclusive
+
+    def forward(self, x):
+        a = self.args
+        size = x.shape[2:] if a["global_pooling"] else a["pool_size"]
+        stride = a["pool_stride"] if not a["global_pooling"] else size
+        if a["pool_type"] == "max":
+            return F.max_pool2d(x, size, stride=stride,
+                                padding=a["pool_padding"],
+                                ceil_mode=a["ceil_mode"])
+        return F.avg_pool2d(x, size, stride=stride,
+                            padding=a["pool_padding"],
+                            ceil_mode=a["ceil_mode"],
+                            exclusive=self.exclusive)
+
+
+__all__ += ["AdaptiveAvgPool1D", "AdaptiveMaxPool1D", "AdaptiveAvgPool3D",
+            "AdaptiveMaxPool3D", "Pool2D"]

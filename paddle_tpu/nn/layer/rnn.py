@@ -20,7 +20,7 @@ from .. import initializer as I
 from .layers import Layer
 
 __all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
-           "LSTM", "GRU", "BiRNN"]
+           "LSTM", "GRU", "BiRNN", "RNNCellBase"]
 
 
 # -- fused scan kernels ------------------------------------------------------

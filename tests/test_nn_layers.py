@@ -182,3 +182,43 @@ def test_sublayer_replacement_and_apply():
     count = [0]
     net.apply(lambda l: count.__setitem__(0, count[0] + 1))
     assert count[0] == 3  # self + 2 children
+
+
+def test_round4_layer_classes():
+    """The 11 layer classes closing the nn.* class surface vs the
+    reference (adaptive pools 1D/3D, Pool2D, BilinearTensorProduct,
+    PairwiseDistance, RowConv, HSigmoidLoss, NCELoss, RNNCellBase
+    export)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 3, 8).astype("float32"))
+    assert nn.AdaptiveAvgPool1D(4)(x).shape == (2, 3, 4)
+    assert nn.AdaptiveMaxPool1D(2)(x).shape == (2, 3, 2)
+    b = nn.BilinearTensorProduct(4, 5, 3)
+    assert b(paddle.to_tensor(np.ones((2, 4), "float32")),
+             paddle.to_tensor(np.ones((2, 5), "float32"))).shape == (2, 3)
+    pd = nn.PairwiseDistance()(
+        paddle.to_tensor(np.zeros((2, 4), "float32")),
+        paddle.to_tensor(np.ones((2, 4), "float32")))
+    np.testing.assert_allclose(np.asarray(pd._value), [2.0, 2.0],
+                               rtol=1e-4)
+    assert nn.RowConv(3, 2)(x.transpose([0, 2, 1])).shape == (2, 8, 3)
+    hs = nn.HSigmoidLoss(6, 10)(
+        paddle.to_tensor(np.ones((3, 6), "float32")),
+        paddle.to_tensor(np.array([1, 2, 3]), "int64"))
+    assert hs.shape == (3, 1) and (hs.numpy() > 0).all()
+    img = paddle.to_tensor(np.ones((1, 2, 4, 4), "float32"))
+    assert nn.Pool2D(2, "avg", 2)(img).shape == (1, 2, 2, 2)
+    nce = nn.NCELoss(20, 6)(
+        paddle.to_tensor(np.ones((3, 6), "float32")),
+        paddle.to_tensor(np.array([1, 2, 3]), "int64"))
+    assert nce.shape == (3, 1)
+    assert nn.AdaptiveMaxPool3D(2)(
+        paddle.to_tensor(np.ones((1, 2, 4, 4, 4), "float32"))
+    ).shape == (1, 2, 2, 2, 2)
+    assert issubclass(nn.LSTMCell, nn.RNNCellBase)
