@@ -73,6 +73,9 @@ _SUBMODULES = ("nn", "optimizer", "metric", "io", "amp", "static",
                "distribution", "version", "utils", "fluid")
 
 
+from ._legacy_api import *  # noqa: F401,F403  — v1/compat root names
+from ._legacy_api import VarBase, LoDTensor, LoDTensorArray  # noqa: F401
+
 # Lazily-injected non-module names (see __getattr__); enumerated so the
 # API.spec snapshot is deterministic regardless of import order.
 __all_lazy__ = ("Model", "summary", "flops", "save", "load")

@@ -1,0 +1,182 @@
+"""Top-level v1/compat names (reference python/paddle/__init__.py exports
+the fluid-era tensor functions and config helpers at the root; this
+module installs the ones with direct 2.0 equivalents). Imported at the
+bottom of paddle_tpu/__init__.py."""
+from __future__ import annotations
+
+import numpy as _np
+
+from . import ops as _ops
+from .core.tensor import Tensor as _Tensor
+from .core import dtype as _dtype_mod
+
+__all__ = ["add_n", "mm", "numel", "rank", "shape", "is_tensor",
+           "broadcast_shape", "has_inf", "has_nan", "fill_constant",
+           "floor_mod", "elementwise_add", "elementwise_sub",
+           "elementwise_mul", "elementwise_div", "elementwise_pow",
+           "elementwise_mod", "elementwise_floordiv", "reduce_sum",
+           "reduce_mean", "reduce_max", "reduce_min", "reduce_prod",
+           "get_default_dtype", "set_default_dtype", "set_printoptions",
+           "get_cudnn_version", "is_compiled_with_xpu",
+           "create_parameter", "create_global_var",
+           "get_tensor_from_selected_rows", "VarBase", "LoDTensor",
+           "LoDTensorArray"]
+
+
+def add_n(inputs):
+    """reference sum_op.cc (paddle.add_n): elementwise sum of a list."""
+    xs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    out = xs[0]
+    for x in xs[1:]:
+        out = _ops.add(out, x)
+    return out
+
+
+def mm(input, mat2):  # noqa: A002
+    return _ops.matmul(input, mat2)
+
+
+def numel(x):
+    from .core.tensor import to_tensor
+    return to_tensor(_np.asarray(int(_np.prod(x.shape)), _np.int64))
+
+
+def rank(input):  # noqa: A002
+    from .core.tensor import to_tensor
+    return to_tensor(_np.asarray(len(input.shape), _np.int32))
+
+
+def shape(input):  # noqa: A002
+    from .core.tensor import to_tensor
+    return to_tensor(_np.asarray(input.shape, _np.int32))
+
+
+def is_tensor(x):
+    return isinstance(x, _Tensor)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def has_inf(x):
+    return _ops.any(_ops.isinf(x))
+
+
+def has_nan(x):
+    return _ops.any(_ops.isnan(x))
+
+
+def fill_constant(shape, dtype, value, name=None):  # noqa: A002
+    return _ops.full(shape, value, dtype)
+
+
+def floor_mod(x, y):
+    return _ops.remainder(x, y)
+
+
+elementwise_add = _ops.add
+elementwise_sub = _ops.subtract
+elementwise_mul = _ops.multiply
+elementwise_div = _ops.divide
+elementwise_pow = _ops.pow
+elementwise_mod = _ops.remainder
+elementwise_floordiv = _ops.floor_divide
+
+
+def reduce_sum(x, dim=None, keep_dim=False):
+    return _ops.sum(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_mean(x, dim=None, keep_dim=False):
+    return _ops.mean(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(x, dim=None, keep_dim=False):
+    return _ops.max(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_min(x, dim=None, keep_dim=False):
+    return _ops.min(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_prod(x, dim=None, keep_dim=False):
+    return _ops.prod(x, axis=dim, keepdim=keep_dim)
+
+
+_default_dtype = ["float32"]
+
+
+def get_default_dtype():
+    return _default_dtype[0]
+
+
+def set_default_dtype(d):
+    """reference framework.set_default_dtype — consulted by to_tensor's
+    float coercion."""
+    _default_dtype[0] = str(_np.dtype(d)) if not isinstance(d, str) else d
+    return _default_dtype[0]
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference tensor print options — maps onto numpy's (Tensors repr
+    through numpy)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def get_cudnn_version():
+    return None   # no CUDA in the loop — reference returns None likewise
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference layers.create_parameter: a standalone trainable Tensor
+    (registered with the current static Program when tracing)."""
+    from . import nn
+    holder = nn.Layer()
+    return holder.create_parameter(list(shape), attr=attr, is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False, name=None):
+    from .core.tensor import Tensor
+    t = Tensor(_np.full(tuple(shape), value,
+                        _np.dtype(dtype if isinstance(dtype, str)
+                                  else _np.dtype(dtype))))
+    t.persistable = persistable
+    return t
+
+
+def get_tensor_from_selected_rows(x):
+    """reference get_tensor_from_selected_rows_op.cc: densify."""
+    from .core.selected_rows import SelectedRows
+    if isinstance(x, SelectedRows):
+        return x.to_dense()
+    v = getattr(x, "_value", x)
+    if isinstance(v, SelectedRows):
+        from .core.tensor import Tensor
+        return Tensor(v.to_dense(), _internal=True)
+    return x
+
+
+VarBase = _Tensor                       # dygraph-era name for Tensor
+
+from .core.ragged import RaggedTensor as LoDTensor  # noqa: E402
+
+LoDTensorArray = list                   # array of LoD tensors
