@@ -12,7 +12,8 @@ __all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
            "AlphaDropout", "Flatten", "Pad1D", "Pad2D", "Pad3D", "Upsample",
            "UpsamplingBilinear2D", "UpsamplingNearest2D", "Identity",
            "Bilinear", "CosineSimilarity", "PixelShuffle", "Unfold",
-           "BilinearTensorProduct", "PairwiseDistance", "RowConv"]
+           "BilinearTensorProduct", "PairwiseDistance", "RowConv",
+           "TreeConv"]
 
 
 class Identity(Layer):
@@ -262,3 +263,27 @@ class RowConv(Layer):
     def forward(self, x):
         from ... import ops
         return ops.row_conv(x, self.weight)
+
+
+class TreeConv(Layer):
+    """reference nn TreeConv over ops.tree_conv (TBCNN)."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size, num_filters], attr=param_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters, output_size], attr=bias_attr, is_bias=True)
+        self.max_depth = max_depth
+
+    def forward(self, nodes_vector, edge_set):
+        from ... import ops
+        out = ops.tree_conv(nodes_vector, edge_set, self.weight,
+                            self.max_depth)
+        if self.bias is not None:
+            out = ops.add(out, ops.transpose(self.bias, [1, 0]))
+        return out

@@ -965,3 +965,66 @@ def py_func(func, x, out_shapes=None, out_dtypes="float32",
     from ._dispatch import defop
     op = defop(call, name="py_func_call")
     return op(*xs)
+
+
+__all__ += ["tree_conv"]
+
+
+@defop
+def tree_conv(nodes_vector, edge_set, filter, max_depth=2):  # noqa: A002
+    """Tree-based convolution (reference tree_conv_op.cc, TBCNN "continuous
+    binary tree": each node's window is itself + its direct children; the
+    child at position j of k mixes the left/right weight matrices with
+    eta_r = (j-1)/(k-1), eta_l = 1-eta_r, and the parent uses the top
+    matrix).
+
+    nodes_vector [B, n, d]; edge_set [B, e, 2] int (parent, child) pairs,
+    1-based with 0 padding (the reference's layout); filter
+    [d, 3, out, num_filters] with axis 1 = (top, left, right).
+    Returns [B, n, out, num_filters]."""
+    x = nodes_vector
+    b, n, d = x.shape
+    _, three, out_dim, nf = filter.shape
+    wt, wl, wr = filter[:, 0], filter[:, 1], filter[:, 2]   # [d, out, nf]
+
+    edges = edge_set.astype(jnp.int32)                      # [B, e, 2]
+    parent = edges[..., 0]
+    child = edges[..., 1]
+    valid = (parent > 0) & (child > 0)
+    p_idx = jnp.clip(parent - 1, 0, n - 1)
+    c_idx = jnp.clip(child - 1, 0, n - 1)
+
+    # children counts + positions per parent (order of appearance)
+    one = valid.astype(jnp.float32)
+    counts = jnp.zeros((b, n))
+    counts = jax.vmap(lambda cnt, pi, v: cnt.at[pi].add(v))(counts, p_idx,
+                                                            one)
+    # position of each edge among its parent's children: cumulative count
+    def pos_scan(pi, v):
+        def body(carry, inp):
+            cnt = carry
+            idx, vv = inp
+            pos = cnt[idx]
+            cnt = cnt.at[idx].add(vv)
+            return cnt, pos
+        _, pos = jax.lax.scan(body, jnp.zeros((n,)), (pi, v))
+        return pos
+    pos = jax.vmap(pos_scan)(p_idx, one)                    # 0-based
+
+    k = jnp.take_along_axis(counts, p_idx, axis=1)          # [B, e]
+    denom = jnp.maximum(k - 1.0, 1.0)
+    eta_r = jnp.where(k > 1, pos / denom, 0.5)
+    eta_l = 1.0 - eta_r
+
+    cx = jnp.take_along_axis(x, c_idx[..., None], axis=1)   # [B, e, d]
+    contrib = (jnp.einsum("bed,dof->beof", cx, wl)
+               * eta_l[..., None, None]
+               + jnp.einsum("bed,dof->beof", cx, wr)
+               * eta_r[..., None, None])
+    contrib = contrib * valid[..., None, None]
+    # scatter-add child contributions onto their parents
+    acc = jnp.zeros((b, n, out_dim, nf), contrib.dtype)
+    acc = jax.vmap(lambda a, pi, c: a.at[pi].add(c))(acc, p_idx, contrib)
+    # parent (top) term for every node
+    acc = acc + jnp.einsum("bnd,dof->bnof", x, wt)
+    return jnp.tanh(acc)

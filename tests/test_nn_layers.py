@@ -222,3 +222,33 @@ def test_round4_layer_classes():
         paddle.to_tensor(np.ones((1, 2, 4, 4, 4), "float32"))
     ).shape == (1, 2, 2, 2, 2)
     assert issubclass(nn.LSTMCell, nn.RNNCellBase)
+
+
+def test_tree_conv_tbcnn():
+    """ops.tree_conv / nn.TreeConv (reference tree_conv_op.cc TBCNN):
+    hand-computed continuous-binary-tree window on a 3-node tree."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, ops
+
+    x = np.zeros((1, 3, 2), "float32")
+    x[0, 0] = [1.0, 0.0]
+    x[0, 1] = [0.0, 1.0]
+    x[0, 2] = [0.0, 2.0]
+    edges = np.array([[[1, 2], [1, 3], [0, 0]]], "int64")  # 0-padded
+    f = np.zeros((2, 3, 1, 1), "float32")
+    f[0, 0, 0, 0] = 1.0   # top: feature 0
+    f[1, 1, 0, 0] = 1.0   # left: feature 1
+    f[1, 2, 0, 0] = 1.0   # right: feature 1
+    out = ops.tree_conv(paddle.to_tensor(x),
+                        paddle.to_tensor(edges, "int64"),
+                        paddle.to_tensor(f))
+    o = np.asarray(out._value)
+    # root window: top(1) + child A at eta_l=1 (1) + child B at eta_r=1 (2)
+    np.testing.assert_allclose(o[0, 0, 0, 0], np.tanh(4.0), rtol=1e-5)
+    np.testing.assert_allclose(o[0, 1, 0, 0], 0.0, atol=1e-6)
+    paddle.seed(0)
+    layer = nn.TreeConv(2, 4, num_filters=2)
+    assert layer(paddle.to_tensor(x),
+                 paddle.to_tensor(edges, "int64")).shape == (1, 3, 4, 2)
