@@ -144,12 +144,34 @@ reduce_max = _alias(lambda x, dim=None, keep_dim=False:
 square = _alias(lambda x: ops.square(x))
 sqrt = _alias(lambda x: ops.sqrt(x))
 abs = _alias(lambda x: ops.abs(x))  # noqa: A001
-elementwise_add = _alias(lambda x, y, axis=-1: ops.add(x, y))
-elementwise_sub = _alias(lambda x, y, axis=-1: ops.subtract(x, y))
-elementwise_mul = _alias(lambda x, y, axis=-1: ops.multiply(x, y))
-elementwise_div = _alias(lambda x, y, axis=-1: ops.divide(x, y))
-elementwise_max = _alias(lambda x, y, axis=-1: ops.maximum(x, y))
-elementwise_min = _alias(lambda x, y, axis=-1: ops.minimum(x, y))
+def _elementwise(op):
+    """v1 elementwise semantics (reference fluid/layers/nn.py
+    elementwise_add: axis aligns y's dims starting at x dim `axis`, act
+    applies an activation to the result). axis=-1 means trailing-aligned
+    numpy broadcasting; otherwise y is reshaped with trailing singleton
+    dims so it broadcasts from dim `axis`."""
+    def f(x, y, axis=-1, act=None, name=None):
+        xnd = len(x.shape)
+        ynd = len(y.shape)
+        if axis not in (-1, xnd - 1) and ynd < xnd:
+            if axis < 0 or axis + ynd > xnd:
+                raise ValueError(
+                    f"elementwise axis={axis} invalid for x.ndim={xnd}, "
+                    f"y.ndim={ynd}")
+            y = ops.reshape(y, list(y.shape) + [1] * (xnd - axis - ynd))
+        out = op(x, y)
+        if act is not None:
+            out = getattr(F, act)(out)
+        return out
+    return f
+
+
+elementwise_add = _elementwise(ops.add)
+elementwise_sub = _elementwise(ops.subtract)
+elementwise_mul = _elementwise(ops.multiply)
+elementwise_div = _elementwise(ops.divide)
+elementwise_max = _elementwise(ops.maximum)
+elementwise_min = _elementwise(ops.minimum)
 mul = _alias(lambda x, y: ops.matmul(x, y))
 matmul = _alias(lambda x, y, transpose_x=False, transpose_y=False:
                 ops.matmul(x, y, transpose_x=transpose_x,

@@ -128,6 +128,12 @@ class MultiHeadAttention(Layer):
 
         q, k, v = self._split_heads(q), self._split_heads(k), self._split_heads(v)
         if isinstance(cache, StaticKVCache):
+            if attn_mask is not None:
+                raise ValueError(
+                    "attn_mask is not supported with a StaticKVCache: "
+                    "causality comes from the cache index, and a padding "
+                    "mask would be silently dropped. Left-trim padding or "
+                    "use the dynamic (list) cache instead.")
             import jax.numpy as jnp
             kj, vj = k._value.astype(cache.k.dtype), \
                 v._value.astype(cache.v.dtype)

@@ -670,7 +670,10 @@ def hash_bucket(x, num_hash=1, mod_by=100000007):
                          for i in range(num_hash)], jnp.int64)
     h = ids[..., None] * seeds
     h = h ^ (h >> 16)
-    return jnp.abs(h) % mod_by
+    # mask to non-negative rather than abs(): the int64 product can wrap
+    # to INT64_MIN, where abs() stays negative and the modulo would yield
+    # a negative bucket id
+    return (h & jnp.int64(0x7FFFFFFFFFFFFFFF)) % mod_by
 
 
 @defop

@@ -26,14 +26,21 @@ def _named_params(obj):
 
 class ExponentialMovingAverage:
     """shadow = decay * shadow + (1 - decay) * param, with the reference's
-    Adam-style bias correction (shadow / (1 - decay^t))."""
+    Adam-style bias correction (shadow / (1 - decay^t)).
+
+    The shadow starts at zero (EMA_0 = 0), exactly as the reference defines
+    it — the /(1 - decay^t) correction assumes that zero init; seeding with
+    the live parameters instead would over-scale apply() by ~1/(1-decay^t)
+    for small t.
+    """
 
     def __init__(self, network, decay=0.999):
         import jax.numpy as jnp
         self._params = _named_params(network)
         self.decay = float(decay)
         self._t = 0
-        self._shadow = {n: jnp.array(p._value) for n, p in self._params}
+        self._shadow = {n: jnp.zeros_like(p._value)
+                        for n, p in self._params}
         self._backup = None
 
     def update(self):

@@ -5,6 +5,7 @@ blocks with causal masking via the fused attention core.
 from __future__ import annotations
 
 import functools
+import threading
 from dataclasses import dataclass
 
 import jax
@@ -200,13 +201,30 @@ class GPT(nn.Layer):
         return to_tensor(out)
 
 
-@functools.lru_cache(maxsize=64)
 def _decode_fn(net, max_new, temperature, top_k, eos_id, total, cache_dtype,
                b, s):
     """Build + jit the whole-generation program (prefill + lax.scan decode):
-    ONE compiled dispatch per generate() call, O(1) work per token. Cached
-    per (model identity, step budget, sampling config, shapes) so repeat
-    calls skip retracing."""
+    ONE compiled dispatch per generate() call, O(1) work per token. The
+    cache lives on the model instance (not a global lru_cache) so the model
+    and its jitted executables are collectable once the model is dropped;
+    a per-instance lock serializes tracing, which temporarily rebinds the
+    layers' parameters to tracers and is not safe to run concurrently."""
+    key = (max_new, temperature, top_k, eos_id, total, cache_dtype, b, s)
+    cache = net.__dict__.setdefault("_decode_cache", {})
+    if key in cache:
+        return cache[key]
+    lock = net.__dict__.setdefault("_decode_lock", threading.Lock())
+    with lock:
+        if key in cache:
+            return cache[key]
+        fn = _build_decode_fn(net, max_new, temperature, top_k, eos_id,
+                              total, cache_dtype, b, s)
+        cache[key] = fn
+        return fn
+
+
+def _build_decode_fn(net, max_new, temperature, top_k, eos_id, total,
+                     cache_dtype, b, s):
     import jax
     import jax.numpy as jnp
 

@@ -45,6 +45,22 @@ def test_ema_apply_restore_roundtrip():
                                    rtol=1e-6)
 
 
+def test_ema_high_decay_few_steps_unbiased():
+    """decay=0.999, t=5: zero-init shadow + /(1-decay^t) correction must
+    reconstruct ~the parameter scale, not over-scale it ~200x (the failure
+    mode of a param-initialized shadow with the same correction)."""
+    paddle.seed(3)
+    net = nn.Linear(3, 1, bias_attr=False)
+    opt = optimizer.SGD(learning_rate=0.0, parameters=net.parameters())
+    ema = optimizer.ExponentialMovingAverage(net, decay=0.999)
+    w0 = np.asarray(net.weight._value).copy()
+    _fit_steps(net, opt, 5, ema=ema)  # lr=0 -> weights constant
+    with ema.average_weights():
+        avg = np.asarray(net.weight._value)
+    # with constant weights, bias-corrected EMA == the weights exactly
+    np.testing.assert_allclose(avg, w0, rtol=1e-4)
+
+
 def test_model_average_window():
     paddle.seed(1)
     net = nn.Linear(3, 1, bias_attr=False)

@@ -38,6 +38,17 @@ def test_fluid_op_aliases():
         fluid.layers.elementwise_add(a, b).numpy(), [[6, 8], [10, 12]])
     np.testing.assert_allclose(fluid.layers.mul(a, b).numpy(),
                                a.numpy() @ b.numpy())
+    # v1 axis semantics: y[C] broadcast against x[N,C,H,W] from dim 1
+    x4 = paddle.to_tensor(np.arange(24, dtype="float32").reshape(2, 3, 2, 2))
+    yc = paddle.to_tensor(np.array([10.0, 20, 30], "float32"))
+    got = fluid.layers.elementwise_add(x4, yc, axis=1).numpy()
+    want = x4.numpy() + yc.numpy().reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(got, want)
+    # act kwarg applies the named activation
+    neg = paddle.to_tensor(np.array([[-5.0, 2]], "float32"))
+    z = paddle.to_tensor(np.array([[0.0, 0]], "float32"))
+    np.testing.assert_allclose(
+        fluid.layers.elementwise_add(neg, z, act="relu").numpy(), [[0, 2]])
     np.testing.assert_allclose(
         fluid.layers.reduce_mean(a, dim=1).numpy(), [1.5, 3.5])
     fc = fluid.layers.fill_constant([2, 2], "float32", 3.0)
