@@ -33,8 +33,13 @@ class StaticFunction:
     program_translator.py StaticFunction)."""
 
     def __init__(self, function, input_spec=None):
-        self._fn = function
-        self._layer = function if isinstance(function, Layer) else None
+        from .dy2static import convert_function, convert_layer
+        if isinstance(function, Layer):
+            self._layer = convert_layer(function)
+            self._fn = function
+        else:
+            self._layer = None
+            self._fn = convert_function(function)
         self._input_spec = input_spec
         self._compiled = {}
 
@@ -175,40 +180,52 @@ def save(layer, path, input_spec=None, **configs):
     if input_spec is None:
         raise ValueError("jit.save requires input_spec on first save")
 
-    was_training = layer.training
-    layer.eval()
-    program = Program("inference")
-    static_mod.enable_static_()
+    from .dy2static import convert_layer
+    # convert Python if/while over tensors -> cond/while ops for the trace;
+    # if save installed the converted forward itself, it removes it after —
+    # export must not permanently mutate the caller's layer (a to_static-
+    # wrapped layer keeps its conversion: the user opted in)
+    had_fwd = "forward" in layer.__dict__
+    convert_layer(layer)
     try:
-        with program_guard(program):
-            feeds = []
-            for i, spec in enumerate(input_spec):
-                shape = [1 if (s is None or s == -1) else s
-                         for s in spec.shape]
-                feeds.append(static_mod.data(spec.name or f"x{i}", shape,
-                                             str(np.dtype(spec.dtype)
-                                                 if not isinstance(spec.dtype, str)
-                                                 else spec.dtype)))
-            with _tape.no_grad():
-                out = layer(*feeds)
-    finally:
-        static_mod.disable_static_()
-        if was_training:
-            layer.train()
+        was_training = layer.training
+        layer.eval()
+        program = Program("inference")
+        static_mod.enable_static_()
+        try:
+            with program_guard(program):
+                feeds = []
+                for i, spec in enumerate(input_spec):
+                    shape = [1 if (s is None or s == -1) else s
+                             for s in spec.shape]
+                    feeds.append(static_mod.data(
+                        spec.name or f"x{i}", shape,
+                        str(np.dtype(spec.dtype)
+                            if not isinstance(spec.dtype, str)
+                            else spec.dtype)))
+                with _tape.no_grad():
+                    out = layer(*feeds)
+        finally:
+            static_mod.disable_static_()
+            if was_training:
+                layer.train()
 
-    outs = out if isinstance(out, (tuple, list)) else [out]
-    program._jit_fetch_vars = list(outs)
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    payload = {
-        "program": program,
-        "feed_names": [v.name for v in feeds],
-    }
-    with open(path + ".pdmodel", "wb") as f:
-        pickle.dump(payload, f, protocol=4)
-    _save(layer.state_dict(), path + ".pdiparams")
-    _export_stablehlo(layer, input_spec, [v.name for v in feeds], path)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        program._jit_fetch_vars = list(outs)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        payload = {
+            "program": program,
+            "feed_names": [v.name for v in feeds],
+        }
+        with open(path + ".pdmodel", "wb") as f:
+            pickle.dump(payload, f, protocol=4)
+        _save(layer.state_dict(), path + ".pdiparams")
+        _export_stablehlo(layer, input_spec, [v.name for v in feeds], path)
+    finally:
+        if not had_fwd:
+            layer.__dict__.pop("forward", None)
 
 
 def _export_stablehlo(layer, input_spec, feed_names, path):

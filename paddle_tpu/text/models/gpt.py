@@ -4,7 +4,7 @@ blocks with causal masking via the fused attention core.
 """
 from __future__ import annotations
 
-import functools
+import collections
 import threading
 from dataclasses import dataclass
 
@@ -72,6 +72,14 @@ class GPT(nn.Layer):
         self.ln_f = nn.LayerNorm(cfg.hidden_size)
         from .bert import _bert_init
         _bert_init(self, std=0.02)
+
+    def __getstate__(self):
+        # the decode cache holds jitted executables and a lock — neither
+        # pickles; they rebuild lazily on first generate() after load
+        d = dict(self.__dict__)
+        d.pop("_decode_cache", None)
+        d.pop("_decode_lock", None)
+        return d
 
     def forward(self, input_ids, labels=None):
         s = input_ids.shape[1]
@@ -201,25 +209,34 @@ class GPT(nn.Layer):
         return to_tensor(out)
 
 
+_DECODE_CACHE_CAP = 64
+
+
 def _decode_fn(net, max_new, temperature, top_k, eos_id, total, cache_dtype,
                b, s):
     """Build + jit the whole-generation program (prefill + lax.scan decode):
     ONE compiled dispatch per generate() call, O(1) work per token. The
-    cache lives on the model instance (not a global lru_cache) so the model
-    and its jitted executables are collectable once the model is dropped;
-    a per-instance lock serializes tracing, which temporarily rebinds the
-    layers' parameters to tracers and is not safe to run concurrently."""
+    LRU-capped cache lives on the instance (net -> cache -> jitted fn ->
+    net is a cycle the GC collects once the model is dropped — a global
+    registry would pin the model forever, since the jitted fn closes over
+    it; GPT.__getstate__ excludes the cache so pickling/deepcopy still
+    work). The per-instance lock is held across lookup and build: tracing
+    temporarily rebinds this layer's parameters to tracers, so concurrent
+    builds on one model are unsafe, while unrelated models stay parallel;
+    holding it for the lookup also keeps LRU eviction race-free."""
     key = (max_new, temperature, top_k, eos_id, total, cache_dtype, b, s)
-    cache = net.__dict__.setdefault("_decode_cache", {})
-    if key in cache:
-        return cache[key]
     lock = net.__dict__.setdefault("_decode_lock", threading.Lock())
     with lock:
+        cache = net.__dict__.setdefault("_decode_cache",
+                                        collections.OrderedDict())
         if key in cache:
+            cache.move_to_end(key)
             return cache[key]
         fn = _build_decode_fn(net, max_new, temperature, top_k, eos_id,
                               total, cache_dtype, b, s)
         cache[key] = fn
+        while len(cache) > _DECODE_CACHE_CAP:
+            cache.popitem(last=False)
         return fn
 
 

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-5 bench retry loop: BENCH_r04 failed only because the TPU tunnel was
+# unreachable, so this keeps attempting the full bench until it lands
+# (VERDICT r04 next-round item 1). Run under tmux; writes
+# BENCH_r05_local.json on success.
+cd /root/repo || exit 1
+for i in $(seq 1 200); do
+  echo "=== attempt $i $(date) ===" >> /root/repo/bench_r05_log.txt
+  BENCH_INIT_TIMEOUT=180 BENCH_MODE=all timeout 3600 \
+    python bench.py > /root/repo/BENCH_r05_local.json.tmp \
+    2>> /root/repo/bench_r05_log.txt
+  rc=$?
+  if [ $rc -eq 0 ] && grep -q '"mfu"' /root/repo/BENCH_r05_local.json.tmp; then
+    mv /root/repo/BENCH_r05_local.json.tmp /root/repo/BENCH_r05_local.json
+    echo "SUCCESS $(date)" >> /root/repo/bench_r05_log.txt
+    exit 0
+  fi
+  echo "attempt $i rc=$rc; sleeping 600s" >> /root/repo/bench_r05_log.txt
+  sleep 600
+done
