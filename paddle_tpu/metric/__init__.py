@@ -249,3 +249,119 @@ class EditDistance(Metric):
 
 
 __all__ += ["ChunkEvaluator", "EditDistance"]
+
+
+__all__ += ["DetectionMAP"]
+
+
+class DetectionMAP:
+    """Mean average precision for detection (reference
+    detection/detection_map_op.cc + fluid/metrics.py DetectionMAP).
+    Host-side accumulator in the TPU design: detections come off-device
+    per batch, AP math is numpy.
+
+    update(detections, gt_boxes, gt_labels, difficult=None):
+      detections [M, 6] rows (label, score, x1, y1, x2, y2) for ONE image;
+      gt_boxes [G, 4]; gt_labels [G]. Call per image.
+    """
+
+    def __init__(self, overlap_threshold=0.5, ap_version="integral",
+                 evaluate_difficult=False, class_num=None):
+        import collections as _c
+        self.overlap_threshold = float(overlap_threshold)
+        self.ap_version = ap_version
+        self.evaluate_difficult = evaluate_difficult
+        self._dets = _c.defaultdict(list)   # cls -> [(score, img, box)]
+        self._gts = _c.defaultdict(list)    # (img, cls) -> [box, ...]
+        self._npos = _c.defaultdict(int)
+        self._img = 0
+
+    def reset(self):
+        self._dets.clear()
+        self._gts.clear()
+        self._npos.clear()
+        self._img = 0
+
+    @staticmethod
+    def _np(v):
+        import numpy as _np
+        return _np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+
+    def update(self, detections, gt_boxes, gt_labels, difficult=None):
+        import numpy as _np
+        det = self._np(detections).reshape(-1, 6)
+        gb = self._np(gt_boxes).reshape(-1, 4)
+        gl = self._np(gt_labels).reshape(-1).astype(int)
+        dif = (self._np(difficult).reshape(-1).astype(bool)
+               if difficult is not None else _np.zeros(len(gl), bool))
+        img = self._img
+        self._img += 1
+        for box, lab, d in zip(gb, gl, dif):
+            self._gts[(img, int(lab))].append((box, bool(d)))
+            if self.evaluate_difficult or not d:
+                self._npos[int(lab)] += 1
+        for row in det:
+            self._dets[int(row[0])].append((float(row[1]), img, row[2:6]))
+
+    @staticmethod
+    def _iou(a, b):
+        import numpy as _np
+        x1 = _np.maximum(a[0], b[:, 0])
+        y1 = _np.maximum(a[1], b[:, 1])
+        x2 = _np.minimum(a[2], b[:, 2])
+        y2 = _np.minimum(a[3], b[:, 3])
+        inter = _np.maximum(x2 - x1, 0) * _np.maximum(y2 - y1, 0)
+        area_a = max((a[2] - a[0]) * (a[3] - a[1]), 0)
+        area_b = _np.maximum(b[:, 2] - b[:, 0], 0) * \
+            _np.maximum(b[:, 3] - b[:, 1], 0)
+        return inter / _np.maximum(area_a + area_b - inter, 1e-10)
+
+    def accumulate(self):
+        import numpy as _np
+        aps = []
+        for cls, dets in self._dets.items():
+            npos = self._npos.get(cls, 0)
+            if npos == 0:
+                continue
+            dets = sorted(dets, key=lambda t: -t[0])
+            matched = {}
+            tp = _np.zeros(len(dets))
+            fp = _np.zeros(len(dets))
+            for i, (_score, img, box) in enumerate(dets):
+                entries = self._gts.get((img, cls), [])
+                if not entries:
+                    fp[i] = 1
+                    continue
+                boxes = _np.stack([e[0] for e in entries])
+                ious = self._iou(box, boxes)
+                j = int(ious.argmax())
+                if ious[j] >= self.overlap_threshold:
+                    difficult = entries[j][1]
+                    if difficult and not self.evaluate_difficult:
+                        continue  # neither tp nor fp
+                    if (img, cls, j) not in matched:
+                        matched[(img, cls, j)] = True
+                        tp[i] = 1
+                    else:
+                        fp[i] = 1
+                else:
+                    fp[i] = 1
+            ctp, cfp = _np.cumsum(tp), _np.cumsum(fp)
+            rec = ctp / npos
+            prec = ctp / _np.maximum(ctp + cfp, 1e-10)
+            if self.ap_version == "11point":
+                ap = 0.0
+                for t in _np.arange(0.0, 1.1, 0.1):
+                    p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+                    ap += p / 11.0
+            else:  # integral
+                ap = 0.0
+                mrec = _np.concatenate([[0.0], rec, [1.0]])
+                mpre = _np.concatenate([[0.0], prec, [0.0]])
+                for i in range(len(mpre) - 2, -1, -1):
+                    mpre[i] = max(mpre[i], mpre[i + 1])
+                idx = _np.where(mrec[1:] != mrec[:-1])[0]
+                ap = float(((mrec[idx + 1] - mrec[idx]) *
+                            mpre[idx + 1]).sum())
+            aps.append(ap)
+        return float(sum(aps) / len(aps)) if aps else 0.0

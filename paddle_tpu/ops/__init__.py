@@ -18,6 +18,7 @@ from .loss import *          # noqa: F401,F403
 from .sequence import *      # noqa: F401,F403
 from .math_extra import *    # noqa: F401,F403
 from .detection import *     # noqa: F401,F403
+from .op_tail import *       # noqa: F401,F403
 
 from . import _bind  # attaches Tensor operators/methods  # noqa: F401,E402
 
@@ -34,7 +35,7 @@ def _register_plain_ops():
 
     mods = ("math", "creation", "manipulation", "reduction", "logic",
             "linalg", "activation", "conv", "norm_ops", "loss", "sequence",
-            "math_extra", "detection")
+            "math_extra", "detection", "op_tail")
     for m in mods:
         mod = sys.modules[f"{__name__}.{m}"]
         public = getattr(mod, "__all__", None) or [

@@ -260,7 +260,9 @@ def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
 
 # -- host-side selection kernels (eager; reference ships CPU-only too) ------
 
-def _nms_np(boxes, scores, threshold, top_k=-1):
+def _nms_np(boxes, scores, threshold, top_k=-1, eta=1.0):
+    """Greedy NMS; eta < 1 is the reference's adaptive mode (threshold
+    decays by eta after each kept box while it stays above 0.5)."""
     order = np.argsort(-scores)
     keep = []
     suppressed = np.zeros(len(boxes), bool)
@@ -278,6 +280,8 @@ def _nms_np(boxes, scores, threshold, top_k=-1):
         inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
         iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
         suppressed |= iou > threshold
+        if eta < 1.0 and threshold > 0.5:
+            threshold *= eta
     return np.asarray(keep, np.int64)
 
 
@@ -672,3 +676,122 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     noobj = -jax.nn.log_sigmoid(-pred_obj)
     total = total + jnp.sum(noobj, axis=(1, 2, 3)) / (a * h * w)
     return total
+
+
+__all__ += ["generate_proposals", "retinanet_detection_output"]
+
+
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=True, return_rois_num=False):
+    """reference detection/generate_proposals_op.cc (+ the v2 variant's
+    pixel_offset flag): RPN box decoding -> clip to image -> min-size
+    filter -> top-K by score -> NMS -> top post_nms. Eager host kernel
+    (dynamic output size, like the reference's CPU kernel); per-image loop
+    over the batch.
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; anchors [H, W, A, 4]
+    (or [H*W*A, 4]); variances like anchors; im_shape [N, 2] (h, w).
+    Returns (rois [R, 4], roi_probs [R, 1]) (+ rois_num [N] if asked).
+    """
+    from ..core.tensor import Tensor
+
+    def _np(v):
+        return np.asarray(v._value if isinstance(v, Tensor) else v)
+
+    sc, bd = _np(scores), _np(bbox_deltas)
+    anc = _np(anchors).reshape(-1, 4).astype(np.float64)
+    var = _np(variances).reshape(-1, 4).astype(np.float64)
+    ims = _np(im_shape)
+    n, a, h, w = sc.shape
+    offset = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_probs, nums = [], [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)          # H,W,A
+        d = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, anc_i, var_i = s[order], d[order], anc[order], var[order]
+        aw = anc_i[:, 2] - anc_i[:, 0] + offset
+        ah = anc_i[:, 3] - anc_i[:, 1] + offset
+        acx, acy = anc_i[:, 0] + aw * 0.5, anc_i[:, 1] + ah * 0.5
+        dx, dy, dw, dh = (d * var_i).T
+        cx, cy = dx * aw + acx, dy * ah + acy
+        bw = np.exp(np.minimum(dw, np.log(1000.0 / 16))) * aw
+        bh = np.exp(np.minimum(dh, np.log(1000.0 / 16))) * ah
+        boxes = np.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - offset,
+                          cy + bh * 0.5 - offset], axis=1)
+        imh, imw = float(ims[i][0]), float(ims[i][1])
+        boxes[:, 0::2] = boxes[:, 0::2].clip(0, imw - offset)
+        boxes[:, 1::2] = boxes[:, 1::2].clip(0, imh - offset)
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] + offset >= min_size)
+                   & (boxes[:, 3] - boxes[:, 1] + offset >= min_size))
+        boxes, s = boxes[keep_sz], s[keep_sz]
+        keep = _nms_np(boxes, s, nms_thresh, top_k=post_nms_top_n, eta=eta)
+        all_rois.append(boxes[keep])
+        all_probs.append(s[keep, None])
+        nums.append(len(keep))
+    rois = np.concatenate(all_rois) if all_rois else np.zeros((0, 4))
+    probs = np.concatenate(all_probs) if all_probs else np.zeros((0, 1))
+    out = (Tensor(jnp.asarray(rois.astype(np.float32)), _internal=True),
+           Tensor(jnp.asarray(probs.astype(np.float32)), _internal=True))
+    if return_rois_num:
+        out += (Tensor(jnp.asarray(np.asarray(nums, np.int32)),
+                       _internal=True),)
+    return out
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.45,
+                               nms_eta=1.0):
+    """reference detection/retinanet_detection_output_op.cc: decode
+    per-FPN-level predictions and run class-wise NMS. Composed from
+    box_coder + multiclass_nms (eager host kernel)."""
+    from ..core.tensor import Tensor
+
+    def _np(v):
+        return np.asarray(v._value if isinstance(v, Tensor) else v)
+
+    box_l = [_np(b) for b in (bboxes if isinstance(bboxes, (list, tuple))
+                              else [bboxes])]
+    sc_l = [_np(s) for s in (scores if isinstance(scores, (list, tuple))
+                             else [scores])]
+    anc_l = [_np(a).reshape(-1, 4) for a in
+             (anchors if isinstance(anchors, (list, tuple)) else [anchors])]
+    n = box_l[0].shape[0]
+    outs = []
+    for i in range(n):
+        dets_boxes, dets_scores = [], []
+        for bx, scl, anc in zip(box_l, sc_l, anc_l):
+            d = bx[i].reshape(-1, 4)
+            s = scl[i].reshape(d.shape[0], -1)
+            aw = anc[:, 2] - anc[:, 0] + 1
+            ah = anc[:, 3] - anc[:, 1] + 1
+            acx, acy = anc[:, 0] + aw * 0.5, anc[:, 1] + ah * 0.5
+            cx, cy = d[:, 0] * aw + acx, d[:, 1] * ah + acy
+            bw, bh = np.exp(d[:, 2]) * aw, np.exp(d[:, 3]) * ah
+            box = np.stack([cx - bw * 0.5, cy - bh * 0.5,
+                            cx + bw * 0.5 - 1, cy + bh * 0.5 - 1], 1)
+            dets_boxes.append(box)
+            dets_scores.append(s)
+        boxes = np.concatenate(dets_boxes)            # [M, 4]
+        scs = np.concatenate(dets_scores)             # [M, C]
+        results = []
+        for c in range(scs.shape[1]):
+            mask = scs[:, c] > score_threshold
+            if not mask.any():
+                continue
+            bsel, ssel = boxes[mask], scs[mask, c]
+            order = np.argsort(-ssel)[:nms_top_k]
+            keep = _nms_np(bsel[order], ssel[order], nms_threshold,
+                           eta=nms_eta)
+            for j in keep:
+                results.append([c, ssel[order][j], *bsel[order][j]])
+        res = np.asarray(sorted(results, key=lambda r: -r[1])[:keep_top_k],
+                         np.float32).reshape(-1, 6)
+        outs.append(res)
+    out = np.concatenate(outs) if outs else np.zeros((0, 6), np.float32)
+    return Tensor(jnp.asarray(out), _internal=True)

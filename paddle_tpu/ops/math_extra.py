@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..core import rng as _rng
@@ -782,6 +783,133 @@ def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0):
 @defop
 def dequantize_abs_max(x, scale, max_range):
     return x.astype(jnp.float32) * scale / max_range
+
+
+__all__ += ["fake_quantize_range_abs_max", "fake_quantize_dequantize_abs_max",
+            "fake_quantize_dequantize_moving_average_abs_max",
+            "fake_channel_wise_quantize_dequantize_abs_max",
+            "fake_channel_wise_dequantize_max_abs", "fake_dequantize_max_abs",
+            "tdm_child", "tdm_sampler"]
+
+
+@defop
+def fake_quantize_range_abs_max(x, in_scale, bit_length=8, window_size=10000,
+                                is_test=False):
+    """reference fake_quantize_op.cc range_abs_max: scale tracks the
+    running max of per-batch abs maxima (window semantics collapse to a
+    running max under jit — the window array is a CPU-loop artifact)."""
+    n = float(2 ** (bit_length - 1) - 1)
+    cur = jnp.max(jnp.abs(x))
+    scale = in_scale if is_test else jnp.maximum(in_scale, cur)
+    q = jnp.round(x / jnp.maximum(scale, 1e-12) * n)
+    return jnp.clip(q, -n, n) / n * scale, scale
+
+
+@defop
+def fake_quantize_dequantize_abs_max(x, bit_length=8):
+    """reference fake_quantize_dequantize composite — one shared kernel
+    with fake_quantize_abs_max (the reference splits them only because
+    its int8 path materializes the codes)."""
+    return fake_quantize_abs_max.raw(x, bit_length)
+
+
+@defop
+def fake_quantize_dequantize_moving_average_abs_max(x, in_state,
+                                                    bit_length=8,
+                                                    moving_rate=0.9):
+    return fake_quantize_moving_average_abs_max.raw(x, in_state,
+                                                    bit_length, moving_rate)
+
+
+@defop
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0):
+    return fake_channel_wise_quantize_abs_max.raw(x, bit_length, quant_axis)
+
+
+@defop
+def fake_channel_wise_dequantize_max_abs(x, scales, max_range=None,
+                                         quant_axis=0, bit_length=8):
+    """reference fake_dequantize_op.cc channel-wise: codes * scale/n per
+    channel."""
+    n = float(2 ** (bit_length - 1) - 1) if max_range is None \
+        else float(max_range)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    return x.astype(jnp.float32) * scales.reshape(shape) / n
+
+
+@defop
+def fake_dequantize_max_abs(x, scale, max_range):
+    """Shared kernel with dequantize_abs_max (fake_dequantize_op.cc names
+    the same math twice)."""
+    return dequantize_abs_max.raw(x, scale, max_range)
+
+
+@defop
+def tdm_child(x, tree_info, child_nums):
+    """reference tdm_child_op.cc (tree-based deep match): gather each
+    node's children ids + leaf mask from the tree_info table
+    (tree_info rows: [item_id, layer, parent, child_0..child_n-1])."""
+    ids = x.astype(jnp.int32)
+    info = tree_info.astype(jnp.int32)
+    children = info[:, 3:3 + child_nums]
+    ch = children[ids.reshape(-1)].reshape(ids.shape + (child_nums,))
+    # a child is a leaf when its own child list is all zeros
+    child_children = children[ch.reshape(-1)].reshape(
+        ch.shape + (child_nums,))
+    leaf_mask = ((ch != 0)
+                 & (child_children == 0).all(-1)).astype(jnp.int32)
+    return ch, leaf_mask
+
+
+def tdm_sampler(x, travel_list, layer_list, neg_samples_num_list,
+                layer_node_num_list, leaf_node_num, output_positive=True,
+                seed=0):
+    """reference tdm_sampler_op.cc: per tree layer, emit the positive
+    node on each sample's root-to-leaf path plus uniform negatives from
+    the same layer. Host-side sampler (data-prep op; matches the
+    reference's CPU-only kernel). Returns (out, label, mask) stacked as
+    [batch, sum(neg+pos per layer)]."""
+    from ..core.tensor import Tensor
+    rng = np.random.RandomState(seed or None)
+    ids = np.asarray(x._value if isinstance(x, Tensor) else x,
+                     np.int64).reshape(-1)
+    travel = np.asarray(travel_list, np.int64)
+    layers = [np.asarray(l, np.int64) for l in layer_list]
+    outs, labels, masks = [], [], []
+    for item in ids:
+        row_o, row_l, row_m = [], [], []
+        for li, (layer_nodes, n_neg) in enumerate(
+                zip(layers, neg_samples_num_list)):
+            pos = int(travel[item, li])
+            if output_positive:
+                row_o.append(pos)
+                row_l.append(1)
+                row_m.append(0 if pos == 0 else 1)
+            cand = layer_nodes[layer_nodes != pos]
+            # exactly n_neg entries per layer (reference pads with
+            # mask=0 instead of emitting ragged rows)
+            n_take = min(n_neg, len(cand))
+            take = rng.choice(cand, size=n_take, replace=False) \
+                if n_take else np.zeros(0, np.int64)
+            for t in take:
+                row_o.append(int(t))
+                row_l.append(0)
+                row_m.append(1)
+            for _ in range(n_neg - n_take):
+                row_o.append(0)
+                row_l.append(0)
+                row_m.append(0)
+        outs.append(row_o)
+        labels.append(row_l)
+        masks.append(row_m)
+    import jax.numpy as _jnp
+    return (Tensor(_jnp.asarray(np.asarray(outs, np.int64)), _internal=True),
+            Tensor(_jnp.asarray(np.asarray(labels, np.int64)),
+                   _internal=True),
+            Tensor(_jnp.asarray(np.asarray(masks, np.int64)),
+                   _internal=True))
 
 
 def chunk_eval(inferences, labels, chunk_scheme="IOB", num_chunk_types=1,

@@ -1,0 +1,247 @@
+"""Long-tail op families closing the registry audit residue
+(tools/op_coverage.py; VERDICT r04 item 3).
+
+Each op cites its reference registration. TPU-first design notes: the
+beam-search pair is batched-dense (fixed [batch, beam] lanes lowered onto
+top_k/one_hot — no LoD, XLA-friendly) instead of the reference's
+LoD-walking CPU kernel (beam_search_op.cc); segment reductions lower to
+jax.ops.segment_*; the rest are direct jnp lowering rules.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng as _rng
+from ..core.dtype import to_jax_dtype
+from ._dispatch import defop, unwrap, wrap
+
+__all__ = [
+    "spectral_norm", "beam_search", "beam_search_decode",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "truncated_normal", "spp", "sampling_id", "dequantize_log",
+    "positive_negative_pair", "print_op", "assert_op",
+]
+
+
+def print_op(x, message="", summarize=20, first_n=-1):
+    """reference print_op.cc: print tensor values as a pass-through.
+    Eager prints immediately (honoring first_n); under a trace it lowers
+    to jax.debug.print, which fires at run time — summarize/first_n are
+    trace-time unknowable there and are ignored (noted divergence)."""
+    v = unwrap(x)
+    if isinstance(v, jax.core.Tracer):
+        # message goes through as data, never as a format string
+        jax.debug.print("{m} {x}", m=message, x=v)
+        return x
+    if first_n and first_n > 0:
+        seen = getattr(print_op, "_counts", None)
+        if seen is None:
+            seen = print_op._counts = {}
+        seen[message] = seen.get(message, 0) + 1
+        if seen[message] > first_n:
+            return x
+    flat = np.asarray(v).reshape(-1)
+    head = flat[:summarize] if summarize and summarize > 0 else flat
+    print(f"{message} shape={tuple(np.shape(v))} "
+          f"dtype={np.asarray(v).dtype} values={head.tolist()}")
+    return x
+
+
+def assert_op(cond, data=None, summarize=20):
+    """reference assert_op.cc: abort when cond is false. Eager raises;
+    under a trace it lowers to jax.debug.check-style callback (XLA has no
+    abort: the check fires when the value lands on the host)."""
+    c = unwrap(cond)
+    if isinstance(c, jax.core.Tracer):
+        def _check(val):
+            if not bool(np.asarray(val).all()):
+                raise AssertionError(
+                    f"Assert failed (traced): {data if data is not None else ''}")
+        jax.debug.callback(_check, c)
+        return cond
+    if not bool(np.asarray(c).all()):
+        extra = ""
+        if data is not None:
+            items = data if isinstance(data, (list, tuple)) else [data]
+            extra = "; data=" + ", ".join(
+                str(np.asarray(unwrap(d)).reshape(-1)[:summarize].tolist())
+                for d in items)
+        raise AssertionError("Assert failed" + extra)
+    return cond
+
+
+@defop
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    """reference spectral_norm_op.cc (fluid/layers/nn.py spectral_norm):
+    sigma-normalized weight via power iteration on the given u/v seed
+    vectors. Returns the normalized weight (the reference op's Out)."""
+    w = jnp.moveaxis(weight, dim, 0)
+    h = w.shape[0]
+    mat = w.reshape(h, -1)
+    for _ in range(max(int(power_iters), 0)):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ mat @ v
+    out = mat / (sigma + eps)
+    return jnp.moveaxis(out.reshape(w.shape), 0, dim)
+
+
+@defop
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id,
+                is_accumulated=True):
+    """reference beam_search_op.cc, batched-dense: one step of beam
+    expansion. pre_ids/pre_scores [B, K]; scores [B, K, V] (log-probs of
+    the candidate step, already accumulated when is_accumulated). Returns
+    (selected_ids [B, K], selected_scores [B, K], parent_idx [B, K]).
+    Finished lanes (pre_id == end_id) emit end_id with their score frozen,
+    matching the reference's finished-branch handling."""
+    b, k, vsz = scores.shape
+    if not is_accumulated:
+        scores = pre_scores[:, :, None] + jax.nn.log_softmax(scores, -1)
+    finished = (pre_ids == end_id)
+    # a finished lane contributes exactly one candidate: end_id at its
+    # frozen score; mask the rest of its row to -inf
+    is_end = (jnp.arange(vsz) == end_id)
+    frozen = jnp.where(is_end, pre_scores[:, :, None],
+                       jnp.full_like(scores, -jnp.inf))
+    total = jnp.where(finished[:, :, None], frozen, scores)
+    flat = total.reshape(b, k * vsz)
+    top_scores, top_idx = jax.lax.top_k(flat, k)
+    parent = (top_idx // vsz).astype(jnp.int32)
+    ids = (top_idx % vsz).astype(pre_ids.dtype)
+    return ids, top_scores, parent
+
+
+@defop
+def beam_search_decode(step_ids, step_parents, end_id):
+    """reference beam_search_decode_op.cc, batched-dense: backtrack the
+    per-step (ids, parents) trellis [T, B, K] into full sequences
+    [B, K, T] plus the final-beam scores ordering (identity here — lanes
+    are already sorted per step by beam_search)."""
+    ids = jnp.asarray(step_ids)
+    parents = jnp.asarray(step_parents)
+    t = ids.shape[0]
+
+    def back(carry, xs):
+        lane = carry                     # [B, K] lane index at step s+1
+        step_id, step_par = xs
+        tok = jnp.take_along_axis(step_id, lane, axis=1)
+        lane = jnp.take_along_axis(step_par, lane, axis=1).astype(jnp.int32)
+        return lane, tok
+
+    b, k = ids.shape[1], ids.shape[2]
+    init = jnp.tile(jnp.arange(k, dtype=jnp.int32)[None, :], (b, 1))
+    _, toks = jax.lax.scan(back, init, (ids[::-1], parents[::-1]))
+    seqs = jnp.transpose(toks[::-1], (1, 2, 0))      # [B, K, T]
+    return seqs
+
+
+def _segment(op_name, data, segment_ids, num_segments=None):
+    data = unwrap(data)
+    seg = unwrap(segment_ids).astype(jnp.int32)
+    if num_segments is None:
+        if isinstance(seg, jax.core.Tracer):
+            raise ValueError(
+                f"segment_{op_name}: num_segments must be passed "
+                "explicitly under jit/to_static (the output shape cannot "
+                "depend on traced ids)")
+        num_segments = int(jnp.max(seg)) + 1 if seg.size else 0
+    fns = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}
+    if op_name == "mean":
+        s = jax.ops.segment_sum(data, seg, num_segments)
+        cnt = jax.ops.segment_sum(jnp.ones_like(seg, data.dtype), seg,
+                                  num_segments)
+        shape = (num_segments,) + (1,) * (data.ndim - 1)
+        return wrap(s / jnp.maximum(cnt, 1).reshape(shape))
+    return wrap(fns[op_name](data, seg, num_segments))
+
+
+def segment_sum(data, segment_ids, num_segments=None):
+    """reference segment_pool_op.cc SUM (paddle.incubate.segment_sum)."""
+    return _segment("sum", data, segment_ids, num_segments)
+
+
+def segment_mean(data, segment_ids, num_segments=None):
+    return _segment("mean", data, segment_ids, num_segments)
+
+
+def segment_max(data, segment_ids, num_segments=None):
+    return _segment("max", data, segment_ids, num_segments)
+
+
+def segment_min(data, segment_ids, num_segments=None):
+    return _segment("min", data, segment_ids, num_segments)
+
+
+def truncated_normal(shape, mean=0.0, std=1.0, dtype="float32"):
+    """reference truncated_gaussian_random_op.cc: N(mean, std) clipped to
+    two standard deviations by resampling (here: jax's inverse-CDF
+    truncated sampler — same distribution, no rejection loop)."""
+    key = _rng.next_key()
+    x = jax.random.truncated_normal(key, -2.0, 2.0, tuple(shape),
+                                    to_jax_dtype(dtype))
+    return wrap(x * std + mean)
+
+
+def spp(x, pyramid_height=3, pool_type="max"):
+    """reference spp_op.cc (spatial pyramid pooling, He et al.): concat of
+    adaptive poolings at 1x1, 2x2, ... 2^(h-1) bins, flattened per image."""
+    from ..nn import functional as F
+    pool = (F.adaptive_max_pool2d if pool_type == "max"
+            else F.adaptive_avg_pool2d)
+    outs = []
+    n = x.shape[0]
+    for level in range(int(pyramid_height)):
+        bins = 2 ** level
+        p = pool(x, output_size=(bins, bins))
+        outs.append(p.reshape([n, -1]))
+    from . import concat
+    return concat(outs, axis=1)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0):  # noqa: A002
+    """reference sampling_id_op.cc: draw r ~ U[min, max) per row and pick
+    the first index where cumsum(p) crosses r — the reference's inverse-
+    CDF walk, vectorized (keeps its behavior for unnormalized rows and
+    non-default ranges, unlike a categorical() resample)."""
+    key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+    p = unwrap(x)
+    r = jax.random.uniform(key, p.shape[:-1], minval=min, maxval=max,
+                           dtype=p.dtype)
+    c = jnp.cumsum(p, axis=-1)
+    idx = jnp.sum(c < r[..., None], axis=-1)
+    return wrap(jnp.clip(idx, 0, p.shape[-1] - 1).astype(jnp.int64))
+
+
+@defop
+def dequantize_log(x, dict_table):
+    """reference dequantize_log_op.cc: int8 -> float through a 128-entry
+    log-scale lookup table; negative codes mirror with sign."""
+    xi = x.astype(jnp.int32)
+    code = jnp.where(xi < 0, xi + 128, xi)
+    val = jnp.take(dict_table, code)
+    return jnp.where(xi < 0, -val, val)
+
+
+@defop
+def positive_negative_pair(score, label, query_ids):
+    """reference positive_negative_pair_op.cc: within each query, count
+    pairs ranked concordantly (positive), discordantly (negative), and
+    ties (neutral) between predicted scores and labels."""
+    s = score.reshape(-1)
+    y = label.reshape(-1).astype(jnp.float32)
+    q = query_ids.reshape(-1)
+    same_q = (q[:, None] == q[None, :])
+    upper = jnp.triu(jnp.ones_like(same_q, dtype=bool), k=1)
+    valid = same_q & upper & (y[:, None] != y[None, :])
+    ds = s[:, None] - s[None, :]
+    dy = y[:, None] - y[None, :]
+    pos = jnp.sum((valid & (ds * dy > 0)).astype(jnp.float32))
+    neg = jnp.sum((valid & (ds * dy < 0)).astype(jnp.float32))
+    neu = jnp.sum((valid & (ds == 0)).astype(jnp.float32))
+    return pos, neg, neu

@@ -1,0 +1,210 @@
+"""Registry-audit residue ops (tools/op_coverage.py; VERDICT r04 item 3):
+spectral_norm, the beam_search pair, segment reductions, spp,
+generate_proposals, quantize variants, tdm ops, DetectionMAP.
+References cited per-op in the implementations."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import metric, ops
+
+
+def T(x, dtype="float32"):
+    return paddle.to_tensor(np.asarray(x, dtype))
+
+
+def test_spectral_norm_unit_sigma_and_grad():
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 6).astype("float32")
+    u = rng.randn(8).astype("float32")
+    v = rng.randn(6).astype("float32")
+    out = ops.spectral_norm(T(w), T(u), T(v), power_iters=30).numpy()
+    np.testing.assert_allclose(np.linalg.svd(out)[1][0], 1.0, rtol=1e-4)
+    # dim=1 normalizes along the other axis, same sigma property
+    out2 = ops.spectral_norm(T(w), T(v), T(u), dim=1,
+                             power_iters=30).numpy()
+    np.testing.assert_allclose(np.linalg.svd(out2)[1][0], 1.0, rtol=1e-4)
+    # differentiable
+    wt = T(w)
+    wt.stop_gradient = False
+    loss = ops.spectral_norm(wt, T(u), T(v), power_iters=3).sum()
+    loss.backward()
+    assert np.isfinite(wt.grad.numpy()).all()
+
+
+def test_beam_search_step_and_decode_roundtrip():
+    # greedy trellis: beam search with K=2 over 3 steps must recover the
+    # highest-probability path
+    b, k, vocab = 1, 2, 4
+    pre_ids = T([[1, 1]], "int64")
+    pre_sc = T([[0.0, 0.0]], "float32")
+    probs = np.array([[[0.1, 0.5, 0.3, 0.1],
+                       [0.25, 0.25, 0.25, 0.25]]], "float32")
+    ids, sc, par = ops.beam_search(pre_ids, pre_sc, T(np.log(probs)),
+                                   beam_size=k, end_id=0)
+    assert ids.numpy().tolist() == [[1, 2]]      # top-2 from lane 0
+    assert par.numpy().tolist() == [[0, 0]]
+    np.testing.assert_allclose(sc.numpy()[0, 0], np.log(0.5), rtol=1e-5)
+
+    # finished lane freezes: pre_id == end_id emits end_id at its score
+    pre_ids2 = T([[0, 3]], "int64")
+    pre_sc2 = T([[-0.1, -5.0]], "float32")
+    ids2, sc2, _ = ops.beam_search(pre_ids2, pre_sc2, T(np.log(probs)),
+                                   beam_size=k, end_id=0)
+    assert ids2.numpy()[0, 0] == 0
+    np.testing.assert_allclose(sc2.numpy()[0, 0], -0.1, rtol=1e-5)
+
+    step_ids = T([[[3, 4]], [[5, 6]]], "int64")
+    step_par = T([[[0, 0]], [[1, 0]]], "int64")
+    seqs = ops.beam_search_decode(step_ids, step_par, end_id=0)
+    assert seqs.numpy().tolist() == [[[4, 5], [3, 6]]]
+
+
+def test_segment_reductions():
+    d = T(np.arange(8).reshape(4, 2))
+    seg = T([0, 0, 1, 1], "int32")
+    np.testing.assert_allclose(ops.segment_sum(d, seg).numpy(),
+                               [[2, 4], [10, 12]])
+    np.testing.assert_allclose(ops.segment_mean(d, seg).numpy(),
+                               [[1, 2], [5, 6]])
+    np.testing.assert_allclose(ops.segment_max(d, seg).numpy(),
+                               [[2, 3], [6, 7]])
+    np.testing.assert_allclose(ops.segment_min(d, seg).numpy(),
+                               [[0, 1], [4, 5]])
+
+
+def test_truncated_normal_bounds():
+    x = ops.truncated_normal([5000], mean=1.0, std=0.5).numpy()
+    assert (x <= 1.0 + 2 * 0.5 + 1e-5).all()
+    assert (x >= 1.0 - 2 * 0.5 - 1e-5).all()
+    assert abs(float(x.mean()) - 1.0) < 0.05
+
+
+def test_spp_shapes_and_values():
+    x = T(np.arange(2 * 3 * 4 * 4).reshape(2, 3, 4, 4))
+    out = ops.spp(x, pyramid_height=2, pool_type="max").numpy()
+    assert out.shape == (2, 3 * (1 + 4))
+    # level 0 equals global max pool per channel
+    np.testing.assert_allclose(out[:, :3],
+                               np.asarray(x.numpy()).max((2, 3)))
+
+
+def test_sampling_id_distribution():
+    p = T(np.tile(np.array([[0.0, 0.0, 1.0]], "float32"), (16, 1)))
+    ids = ops.sampling_id(p, seed=7).numpy()
+    assert (ids == 2).all()
+
+
+def test_fake_quantize_variants_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5).astype("float32")
+    qd, scale = ops.fake_quantize_dequantize_abs_max(T(x))
+    np.testing.assert_allclose(float(scale.numpy()),
+                               np.abs(x).max(), rtol=1e-6)
+    assert np.abs(qd.numpy() - x).max() <= np.abs(x).max() / 127 + 1e-6
+    qd2, st = ops.fake_quantize_dequantize_moving_average_abs_max(
+        T(x), T(1.0))
+    assert np.isfinite(qd2.numpy()).all()
+    qd3, sc3 = ops.fake_channel_wise_quantize_dequantize_abs_max(T(x))
+    np.testing.assert_allclose(sc3.numpy(), np.abs(x).max(1), rtol=1e-6)
+    qd4, sc4 = ops.fake_quantize_range_abs_max(T(x), T(0.5))
+    np.testing.assert_allclose(float(sc4.numpy()),
+                               max(0.5, np.abs(x).max()), rtol=1e-6)
+    codes = np.round(x / np.abs(x).max() * 127)
+    deq = ops.fake_dequantize_max_abs(T(codes), T(np.abs(x).max()),
+                                      127.0).numpy()
+    assert np.abs(deq - x).max() <= np.abs(x).max() / 127 + 1e-6
+    ch_codes = np.round(x / np.abs(x).max(1, keepdims=True) * 127)
+    deq_ch = ops.fake_channel_wise_dequantize_max_abs(
+        T(ch_codes), T(np.abs(x).max(1)), quant_axis=0).numpy()
+    assert np.abs(deq_ch - x).max() <= np.abs(x).max() / 127 + 1e-6
+
+
+def test_dequantize_log_sign_mirror():
+    tab = T(np.linspace(0.1, 12.8, 128))
+    out = ops.dequantize_log(T([-1, 1, 0], "int8"), tab).numpy()
+    np.testing.assert_allclose(out[0], -12.8, rtol=1e-6)
+    np.testing.assert_allclose(out[1], tab.numpy()[1], rtol=1e-6)
+    np.testing.assert_allclose(out[2], 0.1, rtol=1e-6)
+
+
+def test_positive_negative_pair():
+    score = T([0.9, 0.2, 0.5, 0.8])
+    label = T([1.0, 0.0, 1.0, 0.0])
+    qid = T([0, 0, 0, 1], "int64")
+    p, n, u = ops.positive_negative_pair(score, label, qid)
+    # query 0: pairs (0,1): 0.9>0.2 & 1>0 pos; (1,2): 0.2<0.5 & 0<1 pos
+    assert (float(p.numpy()), float(n.numpy()),
+            float(u.numpy())) == (2.0, 0.0, 0.0)
+
+
+def test_generate_proposals_basic():
+    rng = np.random.RandomState(0)
+    sc = rng.rand(1, 3, 4, 4).astype("float32")
+    bd = (rng.randn(1, 12, 4, 4) * 0.05).astype("float32")
+    anc = rng.rand(4, 4, 3, 4).astype("float32") * 10
+    anc[..., 2:] += 15
+    var = np.ones((4, 4, 3, 4), "float32")
+    rois, probs, num = ops.generate_proposals(
+        T(sc), T(bd), T([[32.0, 32.0]]), T(anc), T(var),
+        pre_nms_top_n=30, post_nms_top_n=8, return_rois_num=True)
+    r = rois.numpy()
+    assert r.shape[1] == 4 and r.shape[0] <= 8
+    assert int(num.numpy()[0]) == r.shape[0]
+    assert (r >= 0).all() and (r <= 32).all()
+    # scores sorted descending
+    p = probs.numpy().reshape(-1)
+    assert (np.diff(p) <= 1e-6).all()
+
+
+def test_tdm_child_and_sampler():
+    # tree: 0 pad; 1 root (children 2,3); 2 -> (4,5); 3 -> (6,7);
+    # 4..7 leaves
+    info = np.zeros((8, 5), "int32")
+    info[1] = [1, 0, 0, 2, 3]
+    info[2] = [2, 1, 1, 4, 5]
+    info[3] = [3, 1, 1, 6, 7]
+    for n in (4, 5, 6, 7):
+        info[n] = [n, 2, n // 2, 0, 0]
+    ch, leaf = ops.tdm_child(T([[1]], "int64"), T(info, "int32"), 2)
+    assert ch.numpy().tolist() == [[[2, 3]]]
+    assert leaf.numpy().tolist() == [[[0, 0]]]
+    ch2, leaf2 = ops.tdm_child(T([[2]], "int64"), T(info, "int32"), 2)
+    assert ch2.numpy().tolist() == [[[4, 5]]]
+    assert leaf2.numpy().tolist() == [[[1, 1]]]
+
+    travel = np.array([[0, 0], [0, 0], [0, 0], [0, 0],
+                       [2, 4], [2, 5], [3, 6], [3, 7]], "int64")
+    layers = [np.array([2, 3], "int64"), np.array([4, 5, 6, 7], "int64")]
+    out, lab, mask = ops.tdm_sampler(T([4, 7], "int64"), travel, layers,
+                                     [1, 2], [2, 4], 4, seed=3)
+    o, l = out.numpy(), lab.numpy()
+    assert o.shape == (2, 2 + 3)  # (pos+1neg) + (pos+2neg)
+    assert l.tolist() == [[1, 0, 1, 0, 0]] * 2
+    assert o[0, 0] == 2 and o[0, 2] == 4      # positives on the path
+    assert o[1, 0] == 3 and o[1, 2] == 7
+
+
+def test_print_and_assert_ops(capsys):
+    x = T([1.0, 2.0])
+    ops.print_op(x, message="dbg")
+    assert "dbg" in capsys.readouterr().out
+    ops.assert_op(T([True, True], "bool"))
+    with pytest.raises(AssertionError):
+        ops.assert_op(T([True, False], "bool"), data=[x])
+
+
+def test_detection_map_metric():
+    m = metric.DetectionMAP(overlap_threshold=0.5)
+    # image 0: one gt, one perfect det + one far fp with lower score
+    m.update(np.array([[0, 0.9, 0, 0, 10, 10],
+                       [0, 0.3, 50, 50, 60, 60]], "float32"),
+             np.array([[0, 0, 9, 9]], "float32"), np.array([0]))
+    ap = m.accumulate()
+    assert ap == pytest.approx(1.0)
+    # a missed gt halves recall
+    m.update(np.zeros((0, 6), "float32"),
+             np.array([[0, 0, 9, 9]], "float32"), np.array([0]))
+    assert 0.4 < m.accumulate() < 0.75
+    m.reset()
+    assert m.accumulate() == 0.0
