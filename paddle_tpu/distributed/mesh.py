@@ -62,6 +62,21 @@ def set_mesh(mesh: Mesh, name: str = "default"):
     return mesh
 
 
+def reset_mesh(name: str = None):
+    """Drop a registered mesh (all of them when name is None). Mainly for
+    tests: a leaked dp mesh silently turns every later single-device train
+    step into a GSPMD-partitioned one."""
+    global _default_name
+    with _lock:
+        if name is None:
+            _meshes.clear()
+            _default_name = None
+        else:
+            _meshes.pop(name, None)
+            if _default_name == name:
+                _default_name = next(iter(_meshes), None)
+
+
 def get_mesh(name: str = None) -> Optional[Mesh]:
     with _lock:
         if name is not None:

@@ -178,7 +178,7 @@ class _CompiledEngine:
 
         return step
 
-    def _build_train_fn(self):
+    def _build_train_fn(self, example_in=(), example_lab=()):
         step = self._make_train_step()
         amp_cfg = self.model._amp_configs
         scaler = amp_cfg.get("scaler") if amp_cfg else None
@@ -194,11 +194,17 @@ class _CompiledEngine:
         scale_sh = jax.tree_util.tree_map(lambda _: plan["repl"],
                                           {"scale": 0, "good": 0, "bad": 0}) \
             if scaler is not None else None
+
+        def data_sh(example):  # scalar leaves (rank 0) cannot ride P('dp')
+            return jax.tree_util.tree_map(
+                lambda a: plan["batch"] if np.ndim(a) >= 1
+                else plan["repl"], tuple(example))
+
         return jax.jit(
             step,
             in_shardings=(plan["param"], buffers_sh, slot_sh, plan["repl"],
-                          plan["repl"], plan["repl"], plan["batch"],
-                          plan["batch"], scale_sh),
+                          plan["repl"], plan["repl"], data_sh(example_in),
+                          data_sh(example_lab), scale_sh),
             donate_argnums=(0, 1, 2))
 
     # ---- LocalSGD (strategy.localsgd / adaptive_localsgd) ------------------
@@ -441,7 +447,7 @@ class _CompiledEngine:
             if self._train_fn is None:
                 from .. import profiler as _prof
                 with _prof.RecordEvent("hapi/build_train_fn"):
-                    self._train_fn = self._build_train_fn()
+                    self._train_fn = self._build_train_fn(raw_in, raw_lab)
             amp_cfg = self.model._amp_configs
             scaler = amp_cfg.get("scaler") if amp_cfg else None
             scale_state = scaler.scale_state() if scaler is not None else {}

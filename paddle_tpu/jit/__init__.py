@@ -215,12 +215,23 @@ def save(layer, path, input_spec=None, **configs):
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
-        payload = {
-            "program": program,
-            "feed_names": [v.name for v in feeds],
-        }
-        with open(path + ".pdmodel", "wb") as f:
-            pickle.dump(payload, f, protocol=4)
+        # versioned schema format (ops by registry name + version, JSON +
+        # npz — survives internal module renames; framework/program_serde
+        # .py); pickle only as a fallback for exotic non-registry kernels
+        from ..framework.program_serde import save_program
+        try:
+            save_program(program, path, feed_names=[v.name for v in feeds])
+        except TypeError as e:
+            import warnings
+            warnings.warn(
+                f"falling back to pickle .pdmodel ({e}); this artifact "
+                "will not be loadable across framework refactors")
+            payload = {
+                "program": program,
+                "feed_names": [v.name for v in feeds],
+            }
+            with open(path + ".pdmodel", "wb") as f:
+                pickle.dump(payload, f, protocol=4)
         _save(layer.state_dict(), path + ".pdiparams")
         _export_stablehlo(layer, input_spec, [v.name for v in feeds], path)
     finally:
@@ -295,6 +306,12 @@ def _export_stablehlo(layer, input_spec, feed_names, path):
 
 def load(path, **configs):
     with open(path + ".pdmodel", "rb") as f:
+        head = f.read(1)
+    if head == b"{":  # versioned JSON schema (program_serde)
+        from ..framework.program_serde import load_program
+        program, feed_names = load_program(path)
+        return TranslatedLayer(program, feed_names)
+    with open(path + ".pdmodel", "rb") as f:  # legacy pickle artifacts
         payload = pickle.load(f)
     program = payload["program"]
     return TranslatedLayer(program, payload["feed_names"])

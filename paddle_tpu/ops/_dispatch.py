@@ -20,8 +20,13 @@ from ..core.tensor import Tensor
 OP_REGISTRY = {}
 
 
-def defop(raw_fn=None, *, name=None):
-    """Lift a raw jnp function into a Tensor-level differentiable op."""
+def defop(raw_fn=None, *, name=None, version=1):
+    """Lift a raw jnp function into a Tensor-level differentiable op.
+
+    `version` is the op's schema version recorded into saved models
+    (reference framework.proto:186 op-version map; checked on load by
+    framework/program_serde.py). Bump it when an op's attrs or semantics
+    change incompatibly."""
     def deco(f):
         opname = name or f.__name__.lstrip("_")
 
@@ -31,7 +36,9 @@ def defop(raw_fn=None, *, name=None):
 
         wrapper.raw = f
         wrapper.op_name = opname
-        f.op_name = opname  # lets recorded Programs pickle ops by name
+        wrapper.op_version = int(version)
+        f.op_name = opname  # lets recorded Programs serialize ops by name
+        f.op_version = int(version)
         OP_REGISTRY[opname] = wrapper
         return wrapper
 

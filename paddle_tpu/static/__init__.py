@@ -140,18 +140,30 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
     import copy
     prog = copy.copy(program)
     prog._jit_fetch_vars = list(fetch_vars)
+    # inference export prunes to the feed->fetch subgraph (reference
+    # io.py:1198 save_inference_model): training sections must not survive
+    # into the artifact, or the lowered step would demand label feeds
+    prog.backward_section = None
+    prog.optimizer_section = None
     pruned = eliminate_dead_ops(prog)
 
     feed_names = [v.name for v in feed_vars]
-    with open(path_prefix + ".pdmodel", "wb") as f:
-        pickle.dump({"program": pruned, "feed_names": feed_names}, f,
-                    protocol=4)
+    # versioned schema format (framework/program_serde.py) with pickle
+    # only as a fallback for non-registry kernels — same migration as
+    # jit.save
+    from ..framework.program_serde import save_program
+    try:
+        save_program(pruned, path_prefix, feed_names=feed_names)
+    except TypeError:
+        with open(path_prefix + ".pdmodel", "wb") as f:
+            pickle.dump({"program": pruned, "feed_names": feed_names}, f,
+                        protocol=4)
     save(program, path_prefix + ".pdiparams")
 
     # lower the pruned program once and export it with params baked in
     entry = executor._compile(pruned, sorted(feed_names),
                               [v.var_id for v in fetch_vars], False)
-    step, persist_names, _opt = entry
+    step, persist_names, _opt, _amp_init = entry
     scope = global_scope()
     scope_vals = {n: scope.get(n) for n in persist_names}
     order = {n: i for i, n in enumerate(sorted(feed_names))}
@@ -186,10 +198,17 @@ def load_inference_model(path_prefix, executor=None):
     deployment path use paddle_tpu.inference.Predictor instead.)"""
     import pickle
     with open(path_prefix + ".pdmodel", "rb") as f:
-        payload = pickle.load(f)
-    program = payload["program"]
+        head = f.read(1)
+    if head == b"{":  # versioned JSON schema
+        from ..framework.program_serde import load_program
+        program, feed_names = load_program(path_prefix)
+    else:
+        with open(path_prefix + ".pdmodel", "rb") as f:
+            payload = pickle.load(f)
+        program = payload["program"]
+        feed_names = payload["feed_names"]
     load(program, path_prefix + ".pdiparams")
-    return [program, payload["feed_names"],
+    return [program, feed_names,
             list(getattr(program, "_jit_fetch_vars", []))]
 
 

@@ -35,22 +35,23 @@ class _AmpOptimizer:
     (reference decorator.py OptimizerWithMixedPrecision)."""
 
     def __init__(self, optimizer, amp_lists, level, dtype,
-                 use_dynamic_loss_scaling, init_loss_scaling):
+                 use_dynamic_loss_scaling, init_loss_scaling,
+                 scaling_hparams=None):
         self._opt = optimizer
         self._amp_lists = amp_lists
         self._level = level
         self._dtype = jnp.bfloat16 if str(dtype) in ("bfloat16", "bf16") \
             else jnp.float16
         # bf16 covers f32's exponent range: loss scaling is a no-op for it.
-        # fp16 static training would need in-program dynamic loss scaling,
-        # which the Executor does not implement yet — refuse rather than
-        # silently train with underflowing grads.
-        if self._dtype == jnp.float16 and use_dynamic_loss_scaling:
-            raise NotImplementedError(
-                "static-graph float16 AMP with dynamic loss scaling is not "
-                "supported; use dtype='bfloat16' (TPU-native, needs no "
-                "scaling) or the dygraph GradScaler path")
+        # fp16 threads (scale, good_steps, bad_steps) through the compiled
+        # step — the in-program form of the reference's
+        # check_finite_and_unscale + update_loss_scaling op pair
+        # (contrib/mixed_precision/decorator.py) — updates are skipped on
+        # overflow steps and the scale adapts.
+        self._dynamic = bool(use_dynamic_loss_scaling) \
+            and self._dtype == jnp.float16
         self._init_loss_scaling = init_loss_scaling
+        self._scaling_hparams = dict(scaling_hparams or {})
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
@@ -60,6 +61,9 @@ class _AmpOptimizer:
         if self._amp_lists is not None:
             program.amp_lists = (frozenset(self._amp_lists.white_list),
                                  frozenset(self._amp_lists.black_list))
+        program.amp_dynamic_scaling = self._dynamic
+        program.amp_scaling_hparams = dict(self._scaling_hparams,
+                                           init=self._init_loss_scaling)
         return self._opt.minimize(loss, startup_program=startup_program,
                                   parameters=parameters,
                                   no_grad_set=no_grad_set)
@@ -70,10 +74,16 @@ class _AmpOptimizer:
 
 def decorate(optimizer, amp_lists=None, level="O1", dtype="bfloat16",
              init_loss_scaling=2.0 ** 15, use_dynamic_loss_scaling=True,
-             **kwargs):
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.5, **kwargs):
     """paddle.static.amp.decorate: returns an optimizer whose minimize()
-    enables AMP for the whole program."""
+    enables AMP for the whole program (fp16 adds in-program dynamic loss
+    scaling)."""
     if level not in ("O1", "O2"):
         raise ValueError(f"amp level must be O1/O2, got {level!r}")
+    hparams = {"incr_every_n_steps": incr_every_n_steps,
+               "decr_every_n_nan_or_inf": decr_every_n_nan_or_inf,
+               "incr_ratio": incr_ratio, "decr_ratio": decr_ratio}
     return _AmpOptimizer(optimizer, amp_lists, level, dtype,
-                         use_dynamic_loss_scaling, init_loss_scaling)
+                         use_dynamic_loss_scaling, init_loss_scaling,
+                         scaling_hparams=hparams)

@@ -118,8 +118,11 @@ class Executor:
                                       data_parallel)
             self._cache[key] = entry
             _monitor.stat_add("executor/lowerings")
-        step, persist_names, opt = entry
+        step, persist_names, opt, amp_init = entry
 
+        for n, v0 in (amp_init or {}).items():
+            if not scope.has(n):
+                scope.set(n, v0)
         scope_vals = {n: scope.get(n) for n in persist_names}
         slots, lr, t = {}, jnp.zeros(()), jnp.zeros((), jnp.int32)
         if opt is not None:
@@ -264,11 +267,25 @@ class Executor:
         amp_level = getattr(program, "amp_level", None)
         amp_dtype = getattr(program, "amp_dtype", jnp.bfloat16)
         amp_white, amp_black = getattr(program, "amp_lists", (None, None))
+        # in-program dynamic loss scaling (fp16 static AMP; reference
+        # contrib/mixed_precision/decorator.py + the amp op pair
+        # check_finite_and_unscale / update_loss_scaling): scale state
+        # lives in the scope and threads through the compiled step
+        amp_dyn = bool(getattr(program, "amp_dynamic_scaling", False))
+        amp_hp = dict(getattr(program, "amp_scaling_hparams", {}) or {})
+        # per-program state keys: two programs sharing the global scope
+        # must not share loss-scale state (uid, not name — default names
+        # like "main" repeat across Program objects)
+        _tag = f"{program.name}#{getattr(program, 'uid', id(program))}"
+        _SCALE = f"_amp_loss_scale_@{_tag}"
+        _GOOD = f"_amp_good_steps_@{_tag}"
+        _BAD = f"_amp_bad_steps_@{_tag}"
         persist = list(program.persist_ids.items())
         persist_names = [n for n, _ in persist]
         data_ids = {n: v.var_id for n, v in program.data_vars.items()}
         state_writes = dict(program.state_writes)
         bwd = program.backward_section
+        amp_dyn = amp_dyn and bwd is not None
         opt_sec = program.optimizer_section
         opt = opt_sec[0] if opt_sec else None
         meta = None
@@ -338,22 +355,53 @@ class Executor:
                     return run_ops(env)
 
             new_slots = slots
+            amp_out = {}
             if bwd is not None:
                 loss_var, pairs = bwd
                 grad_names = [p.scope_name for p, _ in pairs]
+                scale = (scope_vals[_SCALE] if amp_dyn
+                         else jnp.ones((), jnp.float32))
 
                 def loss_of(pvals):
                     env2 = forward(pvals)
-                    return env2[loss_var.var_id], env2
+                    loss = env2[loss_var.var_id]
+                    if amp_dyn:  # scaled objective; env keeps the real loss
+                        loss = (loss.astype(jnp.float32) * scale).astype(
+                            loss.dtype)
+                    return loss, env2
 
                 grads, env = jax.grad(loss_of, has_aux=True)(
                     {n: scope_vals[n] for n in grad_names})
+                found_inf = jnp.zeros((), jnp.bool_)
+                if amp_dyn:
+                    from ..amp import (check_finite_and_unscale,
+                                       update_loss_scaling)
+                    grads, found_inf = check_finite_and_unscale(grads,
+                                                                scale)
+                    new_scale, good, bad = update_loss_scaling(
+                        scale, scope_vals[_GOOD], scope_vals[_BAD],
+                        found_inf,
+                        incr_ratio=amp_hp.get("incr_ratio", 2.0),
+                        decr_ratio=amp_hp.get("decr_ratio", 0.5),
+                        incr_every_n_steps=amp_hp.get(
+                            "incr_every_n_steps", 1000),
+                        decr_every_n_nan_or_inf=amp_hp.get(
+                            "decr_every_n_nan_or_inf", 2))
+                    amp_out = {_SCALE: new_scale, _GOOD: good, _BAD: bad}
                 for p, g in pairs:
                     env[g.var_id] = grads[p.scope_name]
                 if opt is not None:
+                    import jax.tree_util as _jtu
                     pvals = {n: scope_vals[n] for n in grad_names}
                     new_p, new_slots = opt.apply_gradients_pure(
                         pvals, grads, slots, lr, t, param_meta=meta)
+                    if amp_dyn:  # skip the update on overflow steps
+                        new_p = _jtu.tree_map(
+                            lambda nw, od: jnp.where(found_inf, od, nw),
+                            new_p, pvals)
+                        new_slots = _jtu.tree_map(
+                            lambda nw, od: jnp.where(found_inf, od, nw),
+                            new_slots, dict(slots))
                     for n, v in new_p.items():
                         env[("param", n)] = v
             else:
@@ -364,14 +412,26 @@ class Executor:
             new_scope = {n: env[vid] for n, vid in persist}
             for n, vid in state_writes.items():
                 new_scope[n] = env[vid]
+            new_scope.update(amp_out)
             if opt is not None and bwd is not None:
                 for p, _ in opt_sec[1]:
                     new_scope[p.scope_name] = env[("param", p.scope_name)]
             fetches = tuple(env[fid] for fid in fetch_ids)
             return fetches, new_scope, new_slots
 
+        amp_init = None
+        read_names = list(persist_names)
+        if amp_dyn:
+            amp_init = {
+                _SCALE: jnp.asarray(amp_hp.get("init", 2.0 ** 15),
+                                    jnp.float32),
+                _GOOD: jnp.zeros((), jnp.int32),
+                _BAD: jnp.zeros((), jnp.int32)}
+            read_names += [_SCALE, _GOOD, _BAD]
+
         # donating the scope only pays off when the step writes it back
-        donate = (1, 2) if (state_writes or opt is not None) else ()
+        donate = (1, 2) if (state_writes or opt is not None or amp_dyn) \
+            else ()
         jitted = jax.jit(step, donate_argnums=donate)
 
         if data_parallel:
@@ -384,11 +444,11 @@ class Executor:
                 jitted = jax.jit(
                     step,
                     in_shardings=((batch,) * len(feed_names),
-                                  {n: repl for n in persist_names},
+                                  {n: repl for n in read_names},
                                   None, repl, repl, repl),
                     donate_argnums=donate)
 
-        return jitted, persist_names, opt
+        return jitted, read_names, opt, amp_init
 
 
 class _DownpourDriver:

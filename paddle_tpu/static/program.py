@@ -238,8 +238,13 @@ class Program:
     """Recorded op list + feed/persistable registry
     (reference framework.py Program; ProgramDesc proto)."""
 
+    _uid_counter = [0]
+
     def __init__(self, name="main"):
         self.name = name
+        with Variable._lock:
+            Program._uid_counter[0] += 1
+            self.uid = Program._uid_counter[0]
         self.ops: List[OpNode] = []
         self.data_vars: Dict[str, Variable] = {}
         self.persistable_vars: Dict[str, Variable] = {}

@@ -143,15 +143,19 @@ def test_data_parallel_compiled_program():
     from paddle_tpu.distributed import mesh as mesh_mod
     import jax
     mesh_mod.init_mesh({"dp": len(jax.devices())})
-    main = static.Program("dp")
-    with static.program_guard(main):
-        x = static.data("x", [-1, 4], "float32")
-        net = nn.Linear(4, 2)
-        loss = paddle.ops.mean(net(x))
-        optimizer.SGD(learning_rate=0.01).minimize(loss)
-    cp = static.CompiledProgram(main).with_data_parallel(loss_name=loss.name)
-    exe = static.Executor()
-    xv = np.random.rand(16, 4).astype("float32")
-    (l1,) = exe.run(cp, feed={"x": xv}, fetch_list=[loss])
-    (l2,) = exe.run(cp, feed={"x": xv}, fetch_list=[loss])
-    assert l2 < l1
+    try:
+        main = static.Program("dp")
+        with static.program_guard(main):
+            x = static.data("x", [-1, 4], "float32")
+            net = nn.Linear(4, 2)
+            loss = paddle.ops.mean(net(x))
+            optimizer.SGD(learning_rate=0.01).minimize(loss)
+        cp = static.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name)
+        exe = static.Executor()
+        xv = np.random.rand(16, 4).astype("float32")
+        (l1,) = exe.run(cp, feed={"x": xv}, fetch_list=[loss])
+        (l2,) = exe.run(cp, feed={"x": xv}, fetch_list=[loss])
+        assert l2 < l1
+    finally:
+        mesh_mod.reset_mesh()  # don't leak the dp mesh into other tests
