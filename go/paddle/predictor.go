@@ -1,0 +1,176 @@
+// Package paddle is the Go inference client for paddle_tpu
+// (analog of the reference go/paddle/predictor.go over its C API;
+// here cgo over paddle_tpu's C-ABI predictor, _native/include/
+// paddle_tpu_capi.h, which serves StableHLO artifacts produced by
+// paddle_tpu.jit.save / static.save_inference_model).
+//
+// Build: the C library embeds Python — link against libpython and the
+// built libpaddle_tpu_capi (see _native/). Typical flags:
+//
+//	CGO_CFLAGS="-I${REPO}/paddle_tpu/_native/include"
+//	CGO_LDFLAGS="-L${REPO}/paddle_tpu/_native/lib -lpaddle_tpu_capi"
+//	PYTHONPATH=${REPO} go build ./...
+package paddle
+
+/*
+#cgo CFLAGS: -I${SRCDIR}/../../paddle_tpu/_native/include
+#cgo LDFLAGS: -L${SRCDIR}/../../paddle_tpu/_native/lib -lpaddle_tpu_capi
+#include <stdint.h>
+#include <stdlib.h>
+#include "paddle_tpu_capi.h"
+*/
+import "C"
+
+import (
+	"errors"
+	"fmt"
+	"runtime"
+	"unsafe"
+)
+
+// DType mirrors PD_DTYPE_*.
+type DType int
+
+const (
+	Float32 DType = 0
+	Int32   DType = 1
+	Int64   DType = 2
+)
+
+// Tensor is a dense input/output value.
+type Tensor struct {
+	Shape []int64
+	DType DType
+	// Exactly one of the slices is set, matching DType.
+	F32 []float32
+	I32 []int32
+	I64 []int64
+}
+
+func (t *Tensor) numel() int {
+	n := 1
+	for _, s := range t.Shape {
+		n *= int(s)
+	}
+	return n
+}
+
+// Predictor wraps a PD_Predictor handle.
+type Predictor struct {
+	h *C.PD_Predictor
+}
+
+func lastError() error {
+	return errors.New(C.GoString(C.PD_GetLastError()))
+}
+
+// NewPredictor loads a jit.save artifact by prefix ("model" ->
+// model.stablehlo + model.pdinfer.json). cipherKeyHex may be "" for
+// unencrypted artifacts.
+func NewPredictor(modelPrefix, cipherKeyHex string) (*Predictor, error) {
+	cp := C.CString(modelPrefix)
+	ck := C.CString(cipherKeyHex)
+	defer C.free(unsafe.Pointer(cp))
+	defer C.free(unsafe.Pointer(ck))
+	h := C.PD_NewPredictor(cp, ck)
+	if h == nil {
+		return nil, lastError()
+	}
+	p := &Predictor{h: h}
+	runtime.SetFinalizer(p, func(p *Predictor) { p.Delete() })
+	return p, nil
+}
+
+// Delete releases the native handle (also installed as a finalizer).
+func (p *Predictor) Delete() {
+	if p.h != nil {
+		C.PD_DeletePredictor(p.h)
+		p.h = nil
+	}
+}
+
+// Run executes the model on inputs and returns the outputs (always
+// float32, per the C ABI). Output buffers are copied into Go memory.
+func (p *Predictor) Run(inputs []*Tensor) ([]*Tensor, error) {
+	if p.h == nil {
+		return nil, errors.New("predictor deleted")
+	}
+	n := len(inputs)
+	bufs := make([]unsafe.Pointer, n)
+	dtypes := make([]C.int, n)
+	shapePtrs := make([]*C.int64_t, n)
+	ndims := make([]C.int, n)
+	shapes := make([][]C.int64_t, n)
+	pinned := make([]interface{}, 0, n)
+	for i, t := range inputs {
+		var ptr unsafe.Pointer
+		switch t.DType {
+		case Float32:
+			if len(t.F32) != t.numel() {
+				return nil, fmt.Errorf("input %d: %d values for shape %v",
+					i, len(t.F32), t.Shape)
+			}
+			ptr = unsafe.Pointer(&t.F32[0])
+			pinned = append(pinned, t.F32)
+		case Int32:
+			ptr = unsafe.Pointer(&t.I32[0])
+			pinned = append(pinned, t.I32)
+		case Int64:
+			ptr = unsafe.Pointer(&t.I64[0])
+			pinned = append(pinned, t.I64)
+		default:
+			return nil, fmt.Errorf("input %d: unknown dtype %d", i, t.DType)
+		}
+		bufs[i] = ptr
+		dtypes[i] = C.int(t.DType)
+		shapes[i] = make([]C.int64_t, len(t.Shape))
+		for j, s := range t.Shape {
+			shapes[i][j] = C.int64_t(s)
+		}
+		if len(shapes[i]) > 0 {
+			shapePtrs[i] = &shapes[i][0]
+		}
+		ndims[i] = C.int(len(t.Shape))
+	}
+	var bufPtr *unsafe.Pointer
+	var dtPtr *C.int
+	var shPtr **C.int64_t
+	var ndPtr *C.int
+	if n > 0 {
+		bufPtr = &bufs[0]
+		dtPtr = &dtypes[0]
+		shPtr = &shapePtrs[0]
+		ndPtr = &ndims[0]
+	}
+	rc := C.PD_PredictorRun(p.h, (*unsafe.Pointer)(bufPtr), dtPtr,
+		(**C.int64_t)(shPtr), ndPtr, C.int(n))
+	runtime.KeepAlive(pinned)
+	if rc != 0 {
+		return nil, lastError()
+	}
+	nOut := int(C.PD_PredictorNumOutputs(p.h))
+	outs := make([]*Tensor, nOut)
+	for i := 0; i < nOut; i++ {
+		var data *C.float
+		var shape *C.int64_t
+		var ndim C.int
+		if C.PD_PredictorOutput(p.h, C.int(i), &data, &shape, &ndim) != 0 {
+			return nil, lastError()
+		}
+		t := &Tensor{DType: Float32}
+		t.Shape = make([]int64, int(ndim))
+		count := 1
+		sh := unsafe.Slice(shape, int(ndim))
+		for j := 0; j < int(ndim); j++ {
+			t.Shape[j] = int64(sh[j])
+			count *= int(sh[j])
+		}
+		src := unsafe.Slice(data, count)
+		t.F32 = make([]float32, count)
+		for j := range src {
+			t.F32[j] = float32(src[j])
+		}
+		outs[i] = t
+	}
+	return outs, nil
+}

@@ -70,7 +70,8 @@ _SUBMODULES = ("nn", "optimizer", "metric", "io", "amp", "static",
                "distributed", "vision", "jit", "hapi", "incubate",
                "profiler", "text", "sysconfig", "callbacks", "inference",
                "framework", "regularizer", "memory", "quantization",
-               "distribution", "version", "utils", "fluid")
+               "distribution", "version", "utils", "fluid", "reader",
+               "dataset")
 
 
 from ._legacy_api import *  # noqa: F401,F403  — v1/compat root names

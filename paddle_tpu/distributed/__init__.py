@@ -6,7 +6,8 @@ named mesh axes + sharding rules + XLA-inserted ICI collectives.
 """
 from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
 from . import mesh  # noqa: F401
-from .mesh import get_mesh, init_mesh, mesh_axis_size, in_spmd_region  # noqa: F401
+from .mesh import (get_mesh, init_hybrid_mesh, init_mesh,  # noqa: F401
+                   mesh_axis_size, in_spmd_region, reset_mesh)
 
 import importlib as _importlib
 

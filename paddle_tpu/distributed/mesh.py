@@ -21,8 +21,9 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-__all__ = ["init_mesh", "get_mesh", "set_mesh", "mesh_axis_size",
-           "in_spmd_region", "named_sharding", "MeshGuard", "auto_mesh"]
+__all__ = ["init_mesh", "init_hybrid_mesh", "get_mesh", "set_mesh",
+           "reset_mesh", "mesh_axis_size", "in_spmd_region",
+           "named_sharding", "MeshGuard", "auto_mesh"]
 
 _lock = threading.Lock()
 _meshes: Dict[str, Mesh] = {}
@@ -52,6 +53,66 @@ def init_mesh(shape: Dict[str, int] = None, name: str = "default",
         if _default_name is None or name == "default":
             _default_name = name
     return mesh
+
+
+def init_hybrid_mesh(ici_shape: Dict[str, int],
+                     dcn_shape: Dict[str, int] = None,
+                     name: str = "default") -> Mesh:
+    """Declare a mesh with DCN axes layered over per-slice ICI axes.
+
+    Devices are grouped by slice (TPU `slice_index`; process index under
+    the CPU emulation, where each host process stands in for a slice) and
+    laid out so DCN axes vary slowest. Collectives over the inner (ICI)
+    axes then stay inside a slice and only the outer (DCN) axes cross the
+    data-center network — the dp-across-slices x tp-within-slice recipe
+    (SURVEY §2.3 DCN row; replaces the reference's per-ring NCCL comm
+    bootstrap gen_nccl_id_op_helper.cc:277).
+
+      init_hybrid_mesh({"tp": 4}, {"dp": 2})   # 2 slices x 4 chips
+    """
+    devices = list(jax.devices())
+
+    # group by TPU slice when the platform reports distinct slices;
+    # otherwise by host process (the CPU emulation, where each process
+    # stands in for a slice — and single-slice multi-host jobs, where DCN
+    # crosses hosts)
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    use_slice = len(slice_ids) > 1 and None not in slice_ids
+
+    def slice_of(d):
+        return d.slice_index if use_slice else d.process_index
+
+    groups: Dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(slice_of(d), []).append(d)
+    slices = [groups[k] for k in sorted(groups)]
+    n_slices = len(slices)
+    per_slice = len(slices[0])
+    if any(len(s) != per_slice for s in slices):
+        raise ValueError(
+            f"uneven slices: {[len(s) for s in slices]} devices per slice")
+    if dcn_shape is None:
+        dcn_shape = {"dp": n_slices}
+    overlap = set(dcn_shape) & set(ici_shape)
+    if overlap:
+        raise ValueError(
+            f"axis name(s) {sorted(overlap)} appear in both dcn_shape and "
+            "ici_shape; hybrid axes must be distinct (e.g. dp over DCN, "
+            "tp/sp over ICI)")
+    need_dcn = int(np.prod(list(dcn_shape.values())))
+    need_ici = int(np.prod(list(ici_shape.values())))
+    if need_dcn != n_slices:
+        raise ValueError(
+            f"dcn_shape {dcn_shape} needs {need_dcn} slices, have "
+            f"{n_slices}")
+    if need_ici != per_slice:
+        raise ValueError(
+            f"ici_shape {ici_shape} needs {need_ici} devices per slice, "
+            f"have {per_slice}")
+    arr = np.array([sorted(s, key=lambda d: d.id) for s in slices])
+    arr = arr.reshape(list(dcn_shape.values()) + list(ici_shape.values()))
+    mesh = Mesh(arr, tuple(dcn_shape.keys()) + tuple(ici_shape.keys()))
+    return set_mesh(mesh, name)
 
 
 def set_mesh(mesh: Mesh, name: str = "default"):

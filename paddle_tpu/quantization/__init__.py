@@ -26,7 +26,8 @@ from ..nn.layer.layers import Layer
 from ..ops._dispatch import defop, unwrap, wrap
 
 __all__ = ["fake_quant", "QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
-           "QuantedLinear", "QuantedConv2D", "weight_quantize"]
+           "HistogramObserver", "QuantedLinear", "QuantedConv2D",
+           "weight_quantize"]
 
 
 # -- fake quant with straight-through estimator -----------------------------
@@ -91,17 +92,90 @@ class AbsmaxObserver(Layer):
         return fake_quant(x, unwrap(self.scale), bits=self.bits)
 
 
+class HistogramObserver(Layer):
+    """Percentile calibration over an accumulated |x| histogram (reference
+    mkldnn_quantizer.cc KL/hist modes, slim PTQ 'hist' algo): outliers do
+    not blow up the scale the way absmax lets them. The histogram range
+    doubles on demand; the final scale is the `percentile` quantile of
+    observed magnitudes."""
+
+    def __init__(self, bins=2048, percentile=0.9999, bits=8):
+        super().__init__()
+        self.bits = bits
+        if int(bins) < 2 or int(bins) % 2:
+            raise ValueError(
+                f"bins must be even and >= 2 (got {bins}): the histogram "
+                "range grows by pair-merging bins")
+        self._bins = int(bins)
+        self._percentile = float(percentile)
+        self._hist = np.zeros(self._bins, np.float64)
+        self._hi = None  # current histogram upper bound
+        self.register_buffer("scale", wrap(jnp.ones((), jnp.float32)))
+        self._calibrating = True
+
+    def observe(self, x):
+        a = np.abs(np.asarray(unwrap(x), np.float32)).reshape(-1)
+        amax = float(a.max()) if a.size else 0.0
+        if amax == 0.0:
+            return
+        if self._hi is None:
+            self._hi = amax
+        while amax > self._hi:  # grow by doubling, pair-merging old bins
+            merged = self._hist.reshape(-1, 2).sum(1)
+            self._hist = np.concatenate(
+                [merged, np.zeros(self._bins - merged.size, np.float64)])
+            self._hi *= 2.0
+        h, _ = np.histogram(a, bins=self._bins, range=(0.0, self._hi))
+        self._hist += h
+        total = self._hist.sum()
+        cdf = np.cumsum(self._hist) / total
+        idx = int(np.searchsorted(cdf, self._percentile))
+        new_scale = (idx + 1) / self._bins * self._hi
+        self.scale.set_value(np.asarray(new_scale, np.float32))
+
+    def forward(self, x):
+        if self.training or self._calibrating:
+            if isinstance(unwrap(x), jax.core.Tracer):
+                # histogram accumulation is host-side numpy; it cannot run
+                # inside a traced step — calibrate eagerly (PTQ.calibrate)
+                # or use absmax for QAT-under-jit
+                if not HistogramObserver._warned_traced:
+                    HistogramObserver._warned_traced = True
+                    import warnings
+                    warnings.warn(
+                        "HistogramObserver saw a traced input: statistics "
+                        "are NOT being collected inside jit. Calibrate "
+                        "eagerly (PTQ.calibrate) or use "
+                        "act_observer='absmax' for jitted QAT.")
+            else:
+                self.observe(x)
+        return fake_quant(x, unwrap(self.scale), bits=self.bits)
+
+
+HistogramObserver._warned_traced = False
+
+
 # -- quantized layer wrappers ----------------------------------------------
+
+def _make_observer(kind, bits):
+    if kind == "histogram":
+        return HistogramObserver(bits=bits)
+    if kind == "absmax":
+        return AbsmaxObserver(bits=bits)
+    raise ValueError(
+        f"unknown act_observer {kind!r}; use 'absmax' or 'histogram'")
+
 
 class QuantedLinear(Layer):
     """Linear with fake-quant on weight (per-out-channel absmax) and
     input activation (observer)."""
 
-    def __init__(self, inner, weight_bits=8, act_bits=8):
+    def __init__(self, inner, weight_bits=8, act_bits=8,
+                 act_observer="absmax"):
         super().__init__()
         self.inner = inner
         self.weight_bits = weight_bits
-        self.act_quanter = AbsmaxObserver(bits=act_bits)
+        self.act_quanter = _make_observer(act_observer, act_bits)
 
     def forward(self, x):
         from ..nn import functional as F
@@ -113,11 +187,12 @@ class QuantedLinear(Layer):
 
 
 class QuantedConv2D(Layer):
-    def __init__(self, inner, weight_bits=8, act_bits=8):
+    def __init__(self, inner, weight_bits=8, act_bits=8,
+                 act_observer="absmax"):
         super().__init__()
         self.inner = inner
         self.weight_bits = weight_bits
-        self.act_quanter = AbsmaxObserver(bits=act_bits)
+        self.act_quanter = _make_observer(act_observer, act_bits)
 
     def forward(self, x):
         x = self.act_quanter(x)
@@ -137,9 +212,10 @@ class QuantConfig:
     """2.x-style config: which layer types quantize, at what widths."""
 
     def __init__(self, activation=None, weight=None, weight_bits=8,
-                 act_bits=8):
+                 act_bits=8, act_observer="absmax"):
         self.weight_bits = weight_bits
         self.act_bits = act_bits
+        self.act_observer = act_observer  # "absmax" | "histogram"
         self.layer_map = {}
         from ..nn.layer.common import Linear
         self.layer_map[Linear] = QuantedLinear
@@ -158,9 +234,10 @@ def _replace_layers(root, config):
     for name, child in list(root._sub_layers.items()):
         qcls = config.layer_map.get(type(child))
         if qcls is not None:
-            root._sub_layers[name] = qcls(child,
-                                          weight_bits=config.weight_bits,
-                                          act_bits=config.act_bits)
+            root._sub_layers[name] = qcls(
+                child, weight_bits=config.weight_bits,
+                act_bits=config.act_bits,
+                act_observer=getattr(config, "act_observer", "absmax"))
             replaced += 1
         else:
             replaced += _replace_layers(child, config)
@@ -185,22 +262,49 @@ class QAT:
     def convert(self, model, inplace=True):
         """Freeze observers for deployment (scales stop updating)."""
         for _, sub in model.named_sublayers(include_self=True):
-            if isinstance(sub, AbsmaxObserver):
+            if isinstance(sub, (AbsmaxObserver, HistogramObserver)):
                 sub._calibrating = False
         model.eval()
         return model
 
 
 class PTQ(QAT):
-    """Post-training quantization: wrap, run calibration batches in eval
-    mode (observers keep observing), then convert."""
+    """Post-training quantization (reference mkldnn_quantizer.cc +
+    slim PTQ): wrap, run calibration batches in eval mode (observers keep
+    observing), then convert. `calibrate` is the whole pass:
+
+        q = PTQ(QuantConfig(act_observer="histogram"))
+        qmodel = q.quantize(model, inplace=False)
+        q.calibrate(qmodel, sample_batches)   # any iterable of inputs
+        q.convert(qmodel)
+        jit.save(qmodel, path, input_spec=...)  # Predictor-loadable
+    """
 
     def quantize(self, model, inplace=True):
         model = super().quantize(model, inplace)
         model.eval()
         for _, sub in model.named_sublayers(include_self=True):
-            if isinstance(sub, AbsmaxObserver):
+            if isinstance(sub, (AbsmaxObserver, HistogramObserver)):
                 sub._calibrating = True
+        return model
+
+    def calibrate(self, model, sample_data, max_batches=None):
+        """Run calibration batches through the wrapped model so observers
+        accumulate activation statistics. sample_data: iterable of inputs
+        (a Tensor/array per batch, or a tuple of them)."""
+        from ..core.tensor import Tensor
+        model.eval()
+        n = 0
+        for batch in sample_data:
+            args = batch if isinstance(batch, (tuple, list)) else (batch,)
+            args = tuple(a if isinstance(a, Tensor) else Tensor(
+                jnp.asarray(np.asarray(a)), _internal=True) for a in args)
+            model(*args)
+            n += 1
+            if max_batches is not None and n >= max_batches:
+                break
+        if n == 0:
+            raise ValueError("calibrate needs at least one sample batch")
         return model
 
 

@@ -40,8 +40,10 @@ def test_sequence_mask_eager_and_jit():
         jax.jit(g)(jnp.asarray([2, 4]))  # dynamic width: loud error
 
 
-def test_to_static_data_dependent_branch_errors():
-    """VERDICT weak #7: tracing must not silently bake `if x.mean() > 0`."""
+def test_to_static_data_dependent_branch_converts():
+    """Round 3 asserted this RAISED (tracing must not silently bake one
+    branch); round 5's dy2static converter (jit/dy2static.py) now lowers
+    the branch to lax.cond, so both sides must evaluate correctly."""
     import paddle_tpu.jit as jit
 
     @jit.to_static
@@ -50,9 +52,10 @@ def test_to_static_data_dependent_branch_errors():
             return x + 1
         return x - 1
 
-    x = paddle.to_tensor(np.ones((4,), "float32"))
-    with pytest.raises(TypeError, match="cond"):
-        f(x)
+    pos = paddle.to_tensor(np.ones((4,), "float32"))
+    neg = paddle.to_tensor(-np.ones((4,), "float32"))
+    np.testing.assert_allclose(np.asarray(f(pos).numpy()), 2.0)
+    np.testing.assert_allclose(np.asarray(f(neg).numpy()), -2.0)
 
 
 def test_static_variable_bool_errors():

@@ -1,0 +1,104 @@
+"""PS transport throughput measurement (VERDICT r04 item 9).
+
+N worker threads x M rounds of pull_sparse + push_sparse_grad of
+realistic batches against a local PSServer; reports rows/sec per op and
+aggregate. Reference design point: distributed/communicator.cc (brpc,
+millions of sparse rows/sec across a cluster); this measures our
+pickle-frames-over-TCP transport on one host and records the number
+in docs/ps_throughput.md so regressions are visible.
+
+Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/ps_load_test.py
+"""
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed.ps import PSClient, PSServer  # noqa: E402
+
+VOCAB = 200_000
+DIM = int(os.environ.get("PS_LOAD_DIM", 16))
+WORKERS = int(os.environ.get("PS_LOAD_WORKERS", 4))
+ROUNDS = int(os.environ.get("PS_LOAD_ROUNDS", 50))
+BATCH_IDS = int(os.environ.get("PS_LOAD_BATCH", 2048))
+
+
+def run_worker(endpoints, wid, results):
+    client = PSClient(endpoints)
+    rng = np.random.RandomState(wid)
+    pulled = pushed = 0
+    t0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        ids = np.unique(rng.randint(0, VOCAB, BATCH_IDS).astype(np.int64))
+        rows = client.pull_sparse("emb", ids)
+        pulled += len(ids)
+        grads = np.asarray(rows, np.float32) * 0 + 0.01
+        client.push_sparse_grad("emb", ids, grads)
+        pushed += len(ids)
+    dt = time.perf_counter() - t0
+    results[wid] = (pulled, pushed, dt)
+    client.close()
+
+
+def main():
+    srv = PSServer(tables={
+        "emb": {"type": "sparse", "dim": DIM, "optimizer": "sgd",
+                "lr": 0.1, "init": "zeros"}})
+    srv.start()
+    try:
+        endpoints = [srv.endpoint]
+        results = {}
+        threads = [threading.Thread(target=run_worker,
+                                    args=(endpoints, w, results))
+                   for w in range(WORKERS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+    finally:
+        srv.shutdown()
+
+    total_pulled = sum(r[0] for r in results.values())
+    total_pushed = sum(r[1] for r in results.values())
+    rows_sec = (total_pulled + total_pushed) / wall
+    pull_sec = total_pulled / wall
+    push_sec = total_pushed / wall
+    print(f"workers={WORKERS} rounds={ROUNDS} batch~{BATCH_IDS} dim={DIM}")
+    print(f"pull rows/sec: {pull_sec:,.0f}")
+    print(f"push rows/sec: {push_sec:,.0f}")
+    print(f"aggregate rows/sec: {rows_sec:,.0f} (wall {wall:.2f}s)")
+
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "ps_throughput.md")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        f.write(
+            "# PS transport throughput\n\n"
+            "Measured by `tools/ps_load_test.py` (local PSServer, "
+            f"{WORKERS} worker threads x {ROUNDS} rounds of pull+push of "
+            f"~{BATCH_IDS} unique rows, dim={DIM}, sgd accessor):\n\n"
+            f"| pull rows/s | push rows/s | aggregate rows/s |\n"
+            f"|---|---|---|\n"
+            f"| {pull_sec:,.0f} | {push_sec:,.0f} | {rows_sec:,.0f} |\n\n"
+            "Context: the reference's brpc Communicator targets millions "
+            "of rows/sec across a cluster of servers; this single-host "
+            "pickle-frame TCP transport serves the functional PS story "
+            "(tables, accessors, geo/async modes). The dense-training "
+            "path never touches it — embeddings ride XLA. Scaling knobs "
+            "if it ever gates a workload: batch frames are already one "
+            "roundtrip per table op; next would be multi-connection "
+            "striping per server.\n")
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
