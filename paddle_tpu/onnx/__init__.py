@@ -1,0 +1,75 @@
+"""paddle.onnx — model-export compat surface.
+
+Analog of reference python/paddle/onnx/export.py (which shells into
+paddle2onnx to emit an ONNX protobuf). Design delta: the TPU-native
+interchange artifact is serialized StableHLO via jax.export — the same
+role ONNX plays for the reference (a framework-neutral deployment graph),
+but directly consumable by XLA on TPU/CPU/GPU with no converter in the
+loop. `export` therefore produces the StableHLO artifact set
+({path}.stablehlo + {path}.pdinfer.json + {path}.pdmodel/.pdiparams),
+loadable by paddle_tpu.inference.Predictor and the C/Go clients.
+
+Emitting an ONNX *protobuf* additionally requires the `onnx` package,
+which is not part of this environment; when importable, `export` also
+writes {path}.onnx via the generic StableHLO->ONNX single-node wrapper
+(function body carried as the serialized StableHLO, mirroring how
+paddle2onnx carries custom ops).
+"""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=11, **configs):
+    """paddle.onnx.export(layer, path, input_spec) — see module docstring.
+
+    Returns the artifact prefix. The reference writes {path}.onnx; here
+    the deployment artifact is {path}.stablehlo (+ metadata); a true
+    .onnx protobuf is written only when the optional `onnx` package is
+    importable.
+    """
+    from .. import jit
+    prefix = path[:-5] if path.endswith(".onnx") else path
+    jit.save(layer, prefix, input_spec=input_spec)
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        warnings.warn(
+            "paddle_tpu.onnx.export wrote the StableHLO deployment "
+            f"artifact ({prefix}.stablehlo); writing an ONNX protobuf "
+            "additionally requires the optional `onnx` package. The "
+            "StableHLO artifact is the TPU-native interchange format — "
+            "load it with paddle_tpu.inference.Predictor or the C/Go "
+            "clients.")
+        return prefix
+    _write_onnx_wrapper(prefix, opset_version)
+    return prefix
+
+
+def _write_onnx_wrapper(prefix, opset_version):
+    import json
+
+    import onnx
+    from onnx import TensorProto, helper
+
+    meta = json.load(open(prefix + ".pdinfer.json"))
+    blob = open(prefix + ".stablehlo", "rb").read()
+    dt_map = {"float32": TensorProto.FLOAT, "int32": TensorProto.INT32,
+              "int64": TensorProto.INT64, "bool": TensorProto.BOOL,
+              "float16": TensorProto.FLOAT16}
+    ins = [helper.make_tensor_value_info(n, dt_map.get(d, TensorProto.FLOAT),
+                                         None)
+           for n, d in zip(meta["input_names"], meta["input_dtypes"])]
+    outs = [helper.make_tensor_value_info(n, TensorProto.FLOAT, s)
+            for n, s in zip(meta["output_names"], meta["output_shapes"])]
+    node = helper.make_node(
+        "StablehloCall", [i.name for i in ins], [o.name for o in outs],
+        domain="org.stablehlo",
+        module=blob)
+    graph = helper.make_graph([node], "paddle_tpu_export", ins, outs)
+    model = helper.make_model(
+        graph, opset_imports=[helper.make_opsetid("", opset_version),
+                              helper.make_opsetid("org.stablehlo", 1)])
+    onnx.save(model, prefix + ".onnx")

@@ -145,7 +145,8 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
     # into the artifact, or the lowered step would demand label feeds
     prog.backward_section = None
     prog.optimizer_section = None
-    pruned = eliminate_dead_ops(prog)
+    from .passes import fold_constants
+    pruned = fold_constants(eliminate_dead_ops(prog))
 
     feed_names = [v.name for v in feed_vars]
     # versioned schema format (framework/program_serde.py) with pickle
