@@ -1,0 +1,102 @@
+/* R shim over the paddle_tpu C-ABI predictor (see r/predictor.R).
+ *
+ * Build: R CMD SHLIB r/pd_shim.c \
+ *          -I paddle_tpu/_native/include \
+ *          -L paddle_tpu/_native/lib -lpaddle_tpu_capi
+ *
+ * Exposes three .Call entry points: R_PD_NewPredictor, R_PD_Run,
+ * R_PD_Delete. Inputs arrive as R single-precision vectors plus integer
+ * shape vectors; outputs return as a list of R numeric arrays with dim
+ * attributes. Mirrors the reference r/ client's role over the C API.
+ */
+#include <R.h>
+#include <Rinternals.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "paddle_tpu_capi.h"
+
+static void pd_finalizer(SEXP ptr) {
+  PD_Predictor* h = (PD_Predictor*)R_ExternalPtrAddr(ptr);
+  if (h) {
+    PD_DeletePredictor(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+SEXP R_PD_NewPredictor(SEXP prefix, SEXP key) {
+  const char* p = CHAR(STRING_ELT(prefix, 0));
+  const char* k = CHAR(STRING_ELT(key, 0));
+  PD_Predictor* h = PD_NewPredictor(p, k);
+  if (!h) error("PD_NewPredictor: %s", PD_GetLastError());
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, pd_finalizer, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP R_PD_Run(SEXP ptr, SEXP bufs, SEXP shapes) {
+  PD_Predictor* h = (PD_Predictor*)R_ExternalPtrAddr(ptr);
+  if (!h) error("predictor deleted");
+  int n = LENGTH(bufs);
+  const void** in_bufs = (const void**)calloc(n, sizeof(void*));
+  int* dtypes = (int*)calloc(n, sizeof(int));
+  const int64_t** in_shapes = (const int64_t**)calloc(n, sizeof(void*));
+  int* ndims = (int*)calloc(n, sizeof(int));
+  int64_t** owned = (int64_t**)calloc(n, sizeof(void*));
+  /* R numeric vectors are double; the C ABI wants float32 — repack */
+  float** packed = (float**)calloc(n, sizeof(void*));
+  for (int i = 0; i < n; i++) {
+    SEXP b = VECTOR_ELT(bufs, i);
+    SEXP s = VECTOR_ELT(shapes, i);
+    int len = LENGTH(b);
+    packed[i] = (float*)calloc(len, sizeof(float));
+    for (int j = 0; j < len; j++) packed[i][j] = (float)REAL(b)[j];
+    in_bufs[i] = packed[i];
+    dtypes[i] = PD_DTYPE_FLOAT32;
+    int nd = LENGTH(s);
+    owned[i] = (int64_t*)calloc(nd, sizeof(int64_t));
+    for (int j = 0; j < nd; j++) owned[i][j] = (int64_t)INTEGER(s)[j];
+    in_shapes[i] = owned[i];
+    ndims[i] = nd;
+  }
+  int rc = PD_PredictorRun(h, in_bufs, dtypes, in_shapes, ndims, n);
+  for (int i = 0; i < n; i++) {
+    free(owned[i]);
+    free(packed[i]);
+  }
+  free(owned);
+  free(packed);
+  free(in_bufs);
+  free(dtypes);
+  free(in_shapes);
+  free(ndims);
+  if (rc != 0) error("PD_PredictorRun: %s", PD_GetLastError());
+
+  int n_out = PD_PredictorNumOutputs(h);
+  SEXP out = PROTECT(allocVector(VECSXP, n_out));
+  for (int i = 0; i < n_out; i++) {
+    const float* data;
+    const int64_t* shape;
+    int ndim;
+    if (PD_PredictorOutput(h, i, &data, &shape, &ndim) != 0)
+      error("PD_PredictorOutput: %s", PD_GetLastError());
+    R_xlen_t count = 1;
+    for (int j = 0; j < ndim; j++) count *= (R_xlen_t)shape[j];
+    SEXP arr = PROTECT(allocVector(REALSXP, count));
+    for (R_xlen_t j = 0; j < count; j++) REAL(arr)[j] = (double)data[j];
+    SEXP dim = PROTECT(allocVector(INTSXP, ndim));
+    for (int j = 0; j < ndim; j++) INTEGER(dim)[j] = (int)shape[j];
+    setAttrib(arr, R_DimSymbol, dim);
+    SET_VECTOR_ELT(out, i, arr);
+    UNPROTECT(2);
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP R_PD_Delete(SEXP ptr) {
+  pd_finalizer(ptr);
+  return R_NilValue;
+}

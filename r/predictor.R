@@ -1,0 +1,45 @@
+# paddle_tpu R inference client (analog of the reference r/ client over
+# its C++ predictor API). The C ABI returns pointers, so the binding is a
+# small compiled shim (pd_shim.c) exposing .Call entry points; this file
+# is the user-facing R surface over it.
+#
+# Usage (direct C route; requires the built libpaddle_tpu_capi):
+#   source("predictor.R")
+#   p <- pd_new_predictor("/path/model", "")
+#   out <- pd_run(p, list(matrix(runif(8), nrow = 2)))   # list of arrays
+#   pd_delete_predictor(p)
+#
+# The wrapper .so exports R-callable shims (R_PD_*) over the C ABI; build
+# it once with:
+#   R CMD SHLIB r/pd_shim.c -L paddle_tpu/_native/lib -lpaddle_tpu_capi
+
+pd_lib_loaded <- FALSE
+
+pd_load <- function(shim_path = "pd_shim.so") {
+  dyn.load(shim_path)
+  pd_lib_loaded <<- TRUE
+  invisible(TRUE)
+}
+
+pd_new_predictor <- function(model_prefix, cipher_key_hex = "") {
+  stopifnot(pd_lib_loaded)
+  .Call("R_PD_NewPredictor", as.character(model_prefix),
+        as.character(cipher_key_hex))
+}
+
+pd_run <- function(predictor, inputs) {
+  stopifnot(pd_lib_loaded)
+  # inputs: list of numeric arrays; shapes are taken from dim()
+  bufs <- lapply(inputs, function(x) as.single(as.vector(x)))
+  shapes <- lapply(inputs, function(x) {
+    d <- dim(x)
+    if (is.null(d)) length(x) else d
+  })
+  .Call("R_PD_Run", predictor, bufs, shapes)
+}
+
+pd_delete_predictor <- function(predictor) {
+  stopifnot(pd_lib_loaded)
+  .Call("R_PD_Delete", predictor)
+  invisible(NULL)
+}
