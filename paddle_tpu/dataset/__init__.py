@@ -109,17 +109,20 @@ imdb.word_dict = lambda: {i: i for i in range(5149)}
 imikolov = _module("imikolov")
 
 
-def _imikolov_reader(mode, n):
+def _imikolov_reader(mode, n, data_file):
     from ..text.datasets import Imikolov
 
     def tf(item):
         return tuple(int(x) for x in np.asarray(item).reshape(-1))
-    return _reader_from(lambda: Imikolov(mode=mode, data_type="NGRAM",
+    return _reader_from(lambda: Imikolov(data_file=data_file, mode=mode,
+                                         data_type="NGRAM",
                                          window_size=n), tf)
 
 
-imikolov.train = lambda word_dict=None, n=5: _imikolov_reader("train", n)
-imikolov.test = lambda word_dict=None, n=5: _imikolov_reader("test", n)
+imikolov.train = lambda word_dict=None, n=5, *, data_file=None: \
+    _imikolov_reader("train", n, data_file)
+imikolov.test = lambda word_dict=None, n=5, *, data_file=None: \
+    _imikolov_reader("test", n, data_file)
 imikolov.build_dict = lambda: {i: i for i in range(2073)}
 
 
@@ -127,29 +130,31 @@ imikolov.build_dict = lambda: {i: i for i in range(2073)}
 flowers = _module("flowers")
 
 
-def _flowers_reader(mode):
+def _flowers_reader(mode, **files):
     from ..vision.datasets import Flowers
 
     def tf(item):
         img, lab = item
         return (np.asarray(img, np.float32),
                 int(np.asarray(lab).reshape(-1)[0]))
-    return _reader_from(lambda: Flowers(mode=mode), tf)
+    return _reader_from(lambda: Flowers(mode=mode, **files), tf)
 
 
-flowers.train = lambda: _flowers_reader("train")
-flowers.test = lambda: _flowers_reader("test")
-flowers.valid = lambda: _flowers_reader("valid")
+flowers.train = lambda **files: _flowers_reader("train", **files)
+flowers.test = lambda **files: _flowers_reader("test", **files)
+flowers.valid = lambda **files: _flowers_reader("valid", **files)
 
 
 # -- movielens --------------------------------------------------------------
 movielens = _module("movielens")
 
 
-def _movielens_reader(mode):
+def _movielens_reader(mode, data_file):
     from ..text.datasets import Movielens
-    return _reader_from(lambda: Movielens(mode=mode))
+    return _reader_from(lambda: Movielens(data_file=data_file, mode=mode))
 
 
-movielens.train = lambda: _movielens_reader("train")
-movielens.test = lambda: _movielens_reader("test")
+movielens.train = lambda data_file=None: _movielens_reader("train",
+                                                           data_file)
+movielens.test = lambda data_file=None: _movielens_reader("test",
+                                                          data_file)

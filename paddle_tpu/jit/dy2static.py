@@ -626,9 +626,12 @@ def _has_nodes(stmts, kinds, *, loop_level=False):
     def walk(node):
         if isinstance(node, kinds):
             return True
+        if isinstance(node, barrier):
+            # barrier applies to the node itself too: generated helper
+            # FunctionDefs sit at statement level, and their internal
+            # returns must not count as the enclosing function's
+            return False
         for child in ast.iter_child_nodes(node):
-            if isinstance(child, barrier):
-                continue
             if walk(child):
                 return True
         return False

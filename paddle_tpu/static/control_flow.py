@@ -74,14 +74,29 @@ def _aval(v):
     return jax.ShapeDtypeStruct(tuple(raw.shape), raw.dtype)
 
 
+def _parent_programs():
+    """Per-thread stack of programs enclosing the current sub-block trace
+    (the SSA form of the reference's scope parent chain, scope.h FindVar);
+    lives on _StaticState beside `forced` so concurrent static builds
+    stay isolated."""
+    from .program import _state
+    return _state.cf_parents
+
+
 def _trace_subblock(fn, arg_vars, name):
     """Trace `fn` over fresh placeholders into its own Program; returns
     (ops, placeholder_ids, out_vars, free_ids)."""
     sub = Program(name)
     ph = [Variable(_aval(v).shape, _aval(v).dtype, program=sub)
           for v in arg_vars]
-    with program_guard(sub), force_program(sub):
-        out = fn(*ph)
+    sub._cf_placeholders = ph
+    parents = _parent_programs()
+    parents.append(default_main_program())
+    try:
+        with program_guard(sub), force_program(sub):
+            out = fn(*ph)
+    finally:
+        parents.pop()
     outs = list(out) if isinstance(out, (tuple, list)) else [out]
     for o in outs:
         if not isinstance(o, Variable):
@@ -105,16 +120,22 @@ def _trace_subblock(fn, arg_vars, name):
 
 def _resolve_free(free_map):
     """free var_id -> the actual outer Variable objects (promoted to op
-    inputs; the SSA form of the reference's parent-scope lookup)."""
-    main = default_main_program()
+    inputs; the SSA form of the reference's parent-scope lookup). Searches
+    the current program AND every enclosing sub-block trace — a nested
+    cond/while may capture a grandparent's variable or an enclosing
+    block's placeholder."""
+    progs = [default_main_program()] + list(reversed(_parent_programs()))
     by_id = {}
-    for v in main.data_vars.values():
-        by_id[v.var_id] = v
-    for v in main.persistable_vars.values():
-        by_id[v.var_id] = v
-    for op in main.ops:
-        for v in op.out_vars:
-            by_id[v.var_id] = v
+    for main in progs:
+        for v in main.data_vars.values():
+            by_id.setdefault(v.var_id, v)
+        for v in main.persistable_vars.values():
+            by_id.setdefault(v.var_id, v)
+        for op in main.ops:
+            for v in op.out_vars:
+                by_id.setdefault(v.var_id, v)
+        for ph in getattr(main, "_cf_placeholders", ()):
+            by_id.setdefault(ph.var_id, ph)
     missing = [name for vid, name in free_map.items() if vid not in by_id]
     if missing:
         raise ValueError(

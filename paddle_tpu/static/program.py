@@ -386,6 +386,7 @@ class _StaticState(threading.local):
         self.main = Program("main")
         self.startup = Program("startup")
         self.forced = None  # sub-block tracing override (control_flow.py)
+        self.cf_parents = []  # enclosing sub-block traces (control_flow.py)
 
 
 _state = _StaticState()

@@ -257,3 +257,132 @@ def test_layer_data_dependent_loop_save_load():
     x2 = np.full((2, 2), 4.0, "float32")
     np.testing.assert_allclose(_np(loaded(paddle.to_tensor(x2))),
                                _np(net(paddle.to_tensor(x2))), rtol=1e-5)
+
+
+def test_elif_chain_tensor_conds():
+    def f(x):
+        if x.mean() > 1.0:
+            return x * 10.0
+        elif x.mean() > 0.0:
+            return x + 100.0
+        return x - 1000.0
+
+    st = jit.to_static(f)
+    big = paddle.to_tensor(np.full((2,), 2.0, "float32"))
+    mid = paddle.to_tensor(np.full((2,), 0.5, "float32"))
+    low = paddle.to_tensor(np.full((2,), -1.0, "float32"))
+    np.testing.assert_allclose(_np(st(big)), 20.0)
+    np.testing.assert_allclose(_np(st(mid)), 100.5)
+    np.testing.assert_allclose(_np(st(low)), -1001.0)
+
+
+def test_tuple_valued_local_through_branch():
+    def f(x):
+        if x.mean() > 0:
+            pair = (x * 2.0, x + 1.0)
+        else:
+            pair = (x * 3.0, x - 1.0)
+        return pair[0] + pair[1]
+
+    st = jit.to_static(f)
+    pos = paddle.to_tensor(np.full((2,), 1.0, "float32"))
+    neg = paddle.to_tensor(np.full((2,), -1.0, "float32"))
+    np.testing.assert_allclose(_np(st(pos)), 4.0)   # 2 + 0... 2x+x+1 = 4
+    np.testing.assert_allclose(_np(st(neg)), -5.0)  # -3 + -2
+
+
+def test_closure_capture_preserved():
+    scale = 3.0
+    offset = paddle.to_tensor(np.full((2,), 10.0, "float32"))
+
+    def f(x):
+        if x.mean() > 0:
+            y = x * scale + offset
+        else:
+            y = x * scale - offset
+        return y
+
+    st = jit.to_static(f)
+    pos = paddle.to_tensor(np.full((2,), 2.0, "float32"))
+    np.testing.assert_allclose(_np(st(pos)), 16.0)
+
+
+def test_super_call_survives_conversion():
+    class Base(nn.Layer):
+        def forward(self, x):
+            return x * 2.0
+
+    class Child(Base):
+        def forward(self, x):
+            h = super().forward(x)   # zero-arg super needs __class__ cell
+            if h.mean() > 0:
+                h = h + 1.0
+            else:
+                h = h - 1.0
+            return h
+
+    net = Child()
+    st = jit.to_static(net)
+    pos = paddle.to_tensor(np.full((2,), 1.0, "float32"))
+    neg = paddle.to_tensor(np.full((2,), -1.0, "float32"))
+    np.testing.assert_allclose(_np(st(pos)), 3.0)
+    np.testing.assert_allclose(_np(st(neg)), -3.0)
+
+
+def test_while_tensor_accumulator_with_aux_string():
+    def f(x):
+        tag = "iter"          # loop-invariant aux value: allowed
+        i = 0
+        while i < 3:
+            x = x + 1.0
+            i += 1
+        assert tag == "iter"
+        return x
+
+    st = jit.to_static(f)
+    np.testing.assert_allclose(
+        _np(st(paddle.to_tensor(np.zeros((2,), "float32")))), 3.0)
+
+
+def test_for_else_clause():
+    def f(x):
+        for i in range(2):
+            x = x + 1.0
+        else:
+            x = x * 10.0
+        return x
+
+    conv = convert_function(f)
+    np.testing.assert_allclose(
+        _np(conv(paddle.to_tensor(np.zeros((2,), "float32")))), 20.0)
+
+
+class ElifNet(nn.Layer):
+    """elif chain + early returns through the STATIC (jit.save) path."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.mean() > 1.0:
+            return h * 10.0
+        elif h.mean() > 0.0:
+            return h + 100.0
+        return h - 1000.0
+
+
+def test_elif_chain_save_load():
+    paddle.seed(0)
+    net = ElifNet()
+    net.eval()
+    xs = [np.full((2, 4), v, "float32") for v in (5.0, 0.05, -5.0)]
+    want = [_np(net(paddle.to_tensor(x))) for x in xs]
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "elif")
+    jit.save(net, path, input_spec=[jit.InputSpec([2, 4], "float32", "x")])
+    loaded = jit.load(path)
+    for x, w in zip(xs, want):
+        np.testing.assert_allclose(_np(loaded(paddle.to_tensor(x))), w,
+                                   rtol=1e-5, atol=1e-5)
