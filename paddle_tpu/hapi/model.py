@@ -14,6 +14,7 @@ step is pjit-partitioned (engine consults distributed.sharding).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import warnings
 from typing import Dict, List, Optional
@@ -424,6 +425,10 @@ class _CompiledEngine:
 
     # ---- public steps ------------------------------------------------------
     def train_batch(self, inputs, labels, update=True):
+        with _eager_scope():
+            return self._train_batch_impl(inputs, labels, update=update)
+
+    def _train_batch_impl(self, inputs, labels, update=True):
         model = self.model
         net = model.network
         net.train()
@@ -512,6 +517,10 @@ class _CompiledEngine:
         return lval, outs
 
     def eval_batch(self, inputs, labels):
+        with _eager_scope():
+            return self._eval_batch_impl(inputs, labels)
+
+    def _eval_batch_impl(self, inputs, labels):
         self.finalize_localsgd()
         net = self.model.network
         net.eval()
@@ -526,6 +535,10 @@ class _CompiledEngine:
         return lval, outs
 
     def predict_batch(self, inputs):
+        with _eager_scope():
+            return self._predict_batch_impl(inputs)
+
+    def _predict_batch_impl(self, inputs):
         self.finalize_localsgd()
         net = self.model.network
         net.eval()
@@ -555,8 +568,35 @@ class _CompiledEngine:
         net.load_functional_state(params, buffers)
 
 
+@contextlib.contextmanager
+def _eager_scope():
+    """The hapi engine is mode-independent (one compiled step replaces
+    BOTH reference adapters, StaticGraphAdapter :223 / DynamicGraphAdapter
+    :608) — it always traces its own jitted program. Suspend static-graph
+    recording for the duration so `paddle.enable_static()` elsewhere in
+    the script doesn't make engine ops append to a Program."""
+    from ..static.program import _state
+    was = _state.enabled
+    _state.enabled = False
+    try:
+        yield
+    finally:
+        _state.enabled = was
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
+        from ..static.program import Variable as _StaticVar
+        for _n, p in network.named_parameters():
+            if isinstance(p, _StaticVar) and p._value is None:
+                raise TypeError(
+                    "Model received a network built under "
+                    "paddle.enable_static() (its parameters are static "
+                    "Variables). The hapi engine compiles its own step and "
+                    "serves both execution modes — construct the network "
+                    "in dygraph (before enable_static), or use the "
+                    "paddle.static Executor workflow for Program-based "
+                    "training.")
         self.network = network
         self._inputs = _to_list(inputs)
         self._labels = _to_list(labels)
@@ -739,7 +779,6 @@ class Model:
                 self._global_step = 0
         self._acp = acp
 
-        import contextlib
         guard = contextlib.nullcontext()
         if acp is not None:
             from ..incubate.checkpoint import PreemptionGuard

@@ -153,3 +153,44 @@ def test_flops_leaves_net_usable_and_modes_intact():
     m = Model(net)
     info2 = m.summary((2, 4))
     assert info2["total_params"] == info["total_params"]
+
+
+def test_model_engine_mode_independent():
+    """The one-engine design delta (reference dual adapters): Model works
+    identically with enable_static() flipped on around the training loop
+    (fit/evaluate included — the guard lives in the engine), records NO
+    ops into the default Program, and a net BUILT under static mode gets
+    a clear error."""
+    import paddle_tpu as paddle
+    from paddle_tpu import io, nn, optimizer, static
+
+    net = nn.Linear(4, 2)
+    m = paddle.Model(net)
+    m.prepare(optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters()),
+              nn.CrossEntropyLoss())
+    x = np.random.RandomState(0).rand(8, 4).astype("float32")
+    y = np.random.RandomState(1).randint(0, 2, (8,)).astype("int64")
+    base = m.train_batch([x], [y])
+    assert np.isfinite(base[0])
+    paddle.enable_static()
+    try:
+        n_ops_before = len(static.default_main_program().ops)
+        again = m.train_batch([x], [y])
+        assert np.isfinite(again[0])
+
+        class _DS(io.Dataset):
+            def __getitem__(self, i):
+                return x[i % 8], y[i % 8]
+
+            def __len__(self):
+                return 8
+
+        m.fit(_DS(), batch_size=4, epochs=1, verbose=0)   # engine path
+        m.evaluate(_DS(), batch_size=4, verbose=0)
+        # the engine must not have appended ops to the static Program
+        assert len(static.default_main_program().ops) == n_ops_before
+        with pytest.raises(TypeError, match="enable_static"):
+            paddle.Model(nn.Linear(4, 2))
+    finally:
+        paddle.disable_static()
