@@ -122,9 +122,12 @@ class Tensor:
             raise TypeError(
                 f"{what} of a traced Tensor inside a jitted/to_static "
                 "function is data-dependent Python control flow, which "
-                "would bake one branch into the compiled program. Use "
-                "paddle.static.nn.cond / while_loop (or keep the branch "
-                "out of the traced region)") from e
+                "would bake one branch into the compiled program. "
+                "@paddle.jit.to_static converts if/while over tensors "
+                "automatically when the function's source is available "
+                "(jit/dy2static.py); otherwise use paddle.static.nn.cond "
+                "/ while_loop, or keep the branch out of the traced "
+                "region") from e
 
     def __bool__(self):
         return self._concretize(bool, "the truth value")

@@ -141,7 +141,9 @@ class Variable(Tensor):
             "graph-build time — Python control flow cannot branch on graph "
             "values (the reference raises the same way, framework.py "
             "Variable.__bool__). Use paddle.static.nn.cond / "
-            "paddle.static.nn.while_loop instead")
+            "paddle.static.nn.while_loop, or decorate the function with "
+            "@paddle.jit.to_static so the branch converts automatically "
+            "(jit/dy2static.py)")
 
     def __bool__(self):
         self._no_concrete("the truth value")
