@@ -5,6 +5,7 @@ fluid/dygraph/layers.py:65).
 """
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer import Layer, Parameter, ParamAttr  # noqa: F401

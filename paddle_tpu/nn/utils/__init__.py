@@ -1,0 +1,237 @@
+"""paddle.nn.utils — weight reparameterizations and parameter utilities.
+
+Analog of reference python/paddle/nn/utils/weight_norm_hook.py
+(weight_norm :155, remove_weight_norm :202) plus the SpectralNorm weight
+transform (reference fluid SpectralNorm layer / spectral_norm_op.cc) in
+the 2.x functional form. Both install a forward-pre-hook that recomputes
+the target weight from the reparameterized pieces INSIDE the traced
+region, so gradients flow to the pieces and the recomputation fuses into
+the step under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _require_eager(p, fn_name):
+    if getattr(p, "_value", None) is None:
+        raise TypeError(
+            f"nn.utils.{fn_name} operates on eager parameters; got a "
+            "static-graph Variable — apply the transform before "
+            "paddle.enable_static() (the reparameterization is part of "
+            "the layer, and traces into any later static program)")
+
+
+def _norm_except_dim(w, dim):
+    import jax.numpy as jnp
+    if dim is None:
+        return jnp.sqrt(jnp.sum(w * w))
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(w * w, axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """w = g * v / ||v||  (Salimans & Kingma; reference
+    weight_norm_hook.py:155). Replaces `name` with `{name}_g` and
+    `{name}_v` parameters and recomputes w in a forward-pre-hook."""
+    import jax.numpy as jnp
+    from ..layer.layers import Parameter
+    from ...core.tensor import Tensor
+
+    if name not in layer._parameters:
+        raise ValueError(f"layer has no parameter {name!r}")
+    _require_eager(layer._parameters[name], "weight_norm")
+    w = layer._parameters.pop(name)
+    wv = np.asarray(w._value)
+    g0 = np.asarray(_norm_except_dim(jnp.asarray(wv), dim))
+    layer.add_parameter(name + "_g", Parameter(g0, name=w.name + "_g"))
+    layer.add_parameter(name + "_v", Parameter(wv, name=w.name + "_v"))
+
+    def hook(lyr, inputs):
+        # Tensor-level math: the tape must record the reparameterization
+        # so grads flow to g and v
+        from ... import ops
+        g = lyr._parameters[name + "_g"]
+        v = lyr._parameters[name + "_v"]
+        if dim is None:
+            vn = ops.sqrt(ops.sum(v * v))
+        else:
+            axes = tuple(i for i in range(len(v.shape)) if i != dim)
+            vn = ops.sqrt(ops.sum(v * v, axis=axes, keepdim=True))
+        wt = g * v / (vn + 1e-12)
+        object.__setattr__(lyr, name, wt)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer.__dict__.setdefault("_wn_hooks", {})[name] = (handle, dim)
+    hook(layer, ())  # materialize immediately for direct weight reads
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g,v back into a single parameter (reference
+    weight_norm_hook.py:202)."""
+    import jax.numpy as jnp
+    from ..layer.layers import Parameter
+
+    hooks = layer.__dict__.get("_wn_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"{name!r} has no weight_norm applied")
+    handle, dim = hooks.pop(name)
+    handle.remove()
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    vn = _norm_except_dim(v._value, dim)
+    w = np.asarray(g._value * v._value / (vn + 1e-12))  # same formula as
+    # the forward hook, so pre/post-remove outputs agree exactly
+    layer.__dict__.pop(name, None)  # drop the hook-computed attr
+    layer.add_parameter(name, Parameter(w))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """w_sn = w / sigma_max(w), sigma estimated by power iteration with
+    persistent u/v buffers (reference spectral_norm_op.cc; paddle 2.x
+    nn.utils.spectral_norm). Each forward advances the iteration FROM the
+    stored u/v and writes the new vectors back into the buffers, so the
+    estimate converges as training proceeds. dim defaults to 1 for
+    Linear/Conv*Transpose (output dim second in their weights), else 0 —
+    the reference's rule."""
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+
+    if name not in layer._parameters:
+        raise ValueError(f"layer has no parameter {name!r}")
+    _require_eager(layer._parameters[name], "spectral_norm")
+    w = layer._parameters[name]
+    if dim is None:
+        cls = type(layer).__name__
+        dim = 1 if (cls == "Linear" or "Transpose" in cls) else 0
+    shape = w.shape
+    h = shape[dim]
+    rest = int(np.prod(shape)) // h
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(h).astype("float32")
+    v0 = rng.randn(rest).astype("float32")
+    u0 /= np.linalg.norm(u0) + eps
+    v0 /= np.linalg.norm(v0) + eps
+    layer.register_buffer(name + "_u", Tensor(jnp.asarray(u0),
+                                              _internal=True))
+    layer.register_buffer(name + "_v", Tensor(jnp.asarray(v0),
+                                              _internal=True))
+    # rename the raw parameter so the hook-computed attr can own `name`
+    orig = layer._parameters.pop(name)
+    layer.add_parameter(name + "_orig", orig)
+
+    def hook(lyr, inputs):
+        import jax
+        from ... import ops
+        worig = lyr._parameters[name + "_orig"]
+        # power iteration on the CURRENT weight, gradient-stopped (the
+        # direction is a constant, as in the reference op)
+        wm = jax.lax.stop_gradient(
+            jnp.moveaxis(worig._value, dim, 0).reshape(h, -1))
+        u = lyr._buffers[name + "_u"]._value
+        v = lyr._buffers[name + "_v"]._value
+        for _ in range(max(int(n_power_iterations), 1)):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        u = jax.lax.stop_gradient(u)
+        v = jax.lax.stop_gradient(v)
+        # sigma on the TAPE (Tensor ops) so grads flow through w/sigma(w)
+        perm = [dim] + [i for i in range(len(shape)) if i != dim]
+        wmat_t = ops.reshape(ops.transpose(worig, perm), [h, -1])
+        u_t = Tensor(u, _internal=True)
+        v_t = Tensor(v, _internal=True)
+        sigma = ops.sum(u_t * ops.matmul(wmat_t, v_t))
+        wsn = worig / (sigma + eps)
+        # persist the advanced u/v so the estimate accumulates; the hapi
+        # engine reads named_buffers back out of the traced step
+        lyr._buffers[name + "_u"] = Tensor(u, _internal=True)
+        lyr._buffers[name + "_v"] = Tensor(v, _internal=True)
+        object.__setattr__(lyr, name, wsn)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer.__dict__.setdefault("_sn_hooks", {})[name] = handle
+    hook(layer, ())
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip over .grad (reference
+    paddle.nn.utils.clip_grad_norm_). Returns the total norm."""
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+    params = [p for p in parameters if getattr(p, "grad", None) is not None]
+    if not params:
+        return Tensor(jnp.zeros(()), _internal=True)
+    grads = [p.grad._value if isinstance(p.grad, Tensor)
+             else jnp.asarray(p.grad) for p in params]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"gradient norm is {float(total)}; set "
+            "error_if_nonfinite=False to clip anyway")
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p, g in zip(params, grads):
+        p.grad = Tensor(g * coef, _internal=True)
+    return Tensor(total, _internal=True)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place elementwise gradient clip to [-clip_value, clip_value]
+    (reference paddle.nn.utils.clip_grad_value_)."""
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+    cv = abs(float(clip_value))
+    for p in parameters:
+        if getattr(p, "grad", None) is None:
+            continue
+        g = p.grad._value if isinstance(p.grad, Tensor) \
+            else jnp.asarray(p.grad)
+        p.grad = Tensor(jnp.clip(g, -cv, cv), _internal=True)
+
+
+def parameters_to_vector(parameters):
+    """Flatten parameters into one 1-D tensor (reference
+    nn/utils/transform_parameters.py)."""
+    import jax.numpy as jnp
+    from ...core.tensor import Tensor
+    parameters = list(parameters)
+    for p in parameters:
+        _require_eager(p, "parameters_to_vector")
+    vals = [jnp.ravel(p._value) for p in parameters]
+    return Tensor(jnp.concatenate(vals) if vals
+                  else jnp.zeros((0,), jnp.float32), _internal=True)
+
+
+def vector_to_parameters(vec, parameters):
+    """Write a flat vector back into the parameter list."""
+    import numpy as _np
+    v = _np.asarray(vec.numpy() if hasattr(vec, "numpy") else vec)
+    parameters = list(parameters)
+    need = sum(int(_np.prod(p.shape)) if p.shape else 1
+               for p in parameters)
+    if need != v.size:
+        raise ValueError(f"vector has {v.size} elements; parameters "
+                         f"consume {need}")
+    off = 0
+    for p in parameters:
+        n = int(_np.prod(p.shape)) if p.shape else 1
+        p.set_value(v[off:off + n].reshape(p.shape))
+        off += n
+    return parameters

@@ -1,0 +1,107 @@
+"""nn.utils reparameterizations (reference nn/utils/weight_norm_hook.py
+weight_norm :155 / remove_weight_norm :202; spectral_norm_op.cc)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def test_weight_norm_roundtrip_and_training():
+    paddle.seed(0)
+    lin = nn.Linear(4, 3, bias_attr=False)
+    w_before = np.asarray(lin.weight.numpy()).copy()
+    nn.utils.weight_norm(lin, dim=0)
+    names = dict(lin.named_parameters())
+    assert "weight_g" in names and "weight_v" in names
+    assert "weight" not in names
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 4)
+                         .astype("float32"))
+    out = lin(x)
+    # reparameterized forward matches the original weight initially
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(x.numpy()) @ w_before, rtol=1e-5)
+    # gradients flow to g and v
+    loss = out.sum()
+    loss.backward()
+    assert names["weight_g"].grad is not None
+    assert names["weight_v"].grad is not None
+    # a training step changes the effective weight
+    opt = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    opt.step()
+    opt.clear_grad()
+    out2 = lin(x)
+    assert not np.allclose(np.asarray(out2.numpy()),
+                           np.asarray(out.numpy()))
+
+    nn.utils.remove_weight_norm(lin)
+    names = dict(lin.named_parameters())
+    assert "weight" in names and "weight_g" not in names
+    out3 = lin(x)
+    np.testing.assert_allclose(np.asarray(out3.numpy()),
+                               np.asarray(out2.numpy()), rtol=1e-5)
+    with pytest.raises(ValueError, match="no weight_norm"):
+        nn.utils.remove_weight_norm(lin)
+
+
+def test_spectral_norm_bounds_sigma():
+    paddle.seed(1)
+    lin = nn.Linear(6, 8, bias_attr=False)
+    lin.weight.set_value(np.asarray(lin.weight.numpy()) * 10.0)
+    nn.utils.spectral_norm(lin, n_power_iterations=10)
+    x = paddle.to_tensor(np.eye(6, dtype="float32"))
+    lin(x)  # runs the hook (power iteration + normalize)
+    w_eff = np.asarray(lin.weight.numpy())
+    assert np.linalg.svd(w_eff)[1][0] == pytest.approx(1.0, rel=1e-2)
+
+
+def test_parameters_vector_roundtrip():
+    paddle.seed(2)
+    net = nn.Linear(3, 2)
+    params = list(net.parameters())
+    vec = nn.utils.parameters_to_vector(params)
+    assert vec.shape == (3 * 2 + 2,)
+    flat = np.asarray(vec.numpy())
+    nn.utils.vector_to_parameters(paddle.to_tensor(flat * 2.0), params)
+    np.testing.assert_allclose(
+        np.asarray(nn.utils.parameters_to_vector(params).numpy()),
+        flat * 2.0, rtol=1e-6)
+    with pytest.raises(ValueError, match="elements"):
+        nn.utils.vector_to_parameters(
+            paddle.to_tensor(np.zeros(3, "float32")), params)
+
+
+def test_spectral_norm_buffers_persist_and_grads_flow():
+    paddle.seed(3)
+    lin = nn.Linear(6, 8, bias_attr=False)
+    nn.utils.spectral_norm(lin, n_power_iterations=1)
+    u0 = np.asarray(lin._buffers["weight_u"].numpy()).copy()
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 6)
+                         .astype("float32"))
+    lin(x)
+    u1 = np.asarray(lin._buffers["weight_u"].numpy())
+    assert not np.allclose(u0, u1)  # the iteration advanced the buffer
+    # grads flow through sigma to the original weight
+    loss = lin(x).sum()
+    loss.backward()
+    worig = dict(lin.named_parameters())["weight_orig"]
+    assert worig.grad is not None
+    assert np.isfinite(np.asarray(worig.grad.numpy())).all()
+
+
+def test_clip_grad_norm_and_value():
+    net = nn.Linear(4, 2, bias_attr=False)
+    x = paddle.to_tensor(np.ones((2, 4), "float32") * 100.0)
+    net(x).sum().backward()
+    g0 = np.asarray(net.weight.grad.numpy()).copy()
+    total = nn.utils.clip_grad_norm_(net.parameters(), max_norm=1.0)
+    assert float(np.asarray(total.numpy())) == pytest.approx(
+        np.linalg.norm(g0), rel=1e-5)
+    g1 = np.asarray(net.weight.grad.numpy())
+    assert np.linalg.norm(g1) == pytest.approx(1.0, rel=1e-4)
+
+    net.weight.grad = paddle.to_tensor(
+        np.array([[5.0, -7.0, 0.1, 2.0]] * 2, "float32").T)
+    nn.utils.clip_grad_value_(net.parameters(), 2.5)
+    g2 = np.asarray(net.weight.grad.numpy())
+    assert g2.max() <= 2.5 and g2.min() >= -2.5

@@ -18,6 +18,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 
 NAMESPACES = [
     "paddle_tpu", "paddle_tpu.nn", "paddle_tpu.nn.functional",
+    "paddle_tpu.nn.utils",
     "paddle_tpu.optimizer", "paddle_tpu.optimizer.lr", "paddle_tpu.static",
     "paddle_tpu.static.nn", "paddle_tpu.distributed",
     "paddle_tpu.distributed.fleet", "paddle_tpu.amp", "paddle_tpu.metric",
