@@ -71,7 +71,7 @@ _SUBMODULES = ("nn", "optimizer", "metric", "io", "amp", "static",
                "profiler", "text", "sysconfig", "callbacks", "inference",
                "framework", "regularizer", "memory", "quantization",
                "distribution", "version", "utils", "fluid", "reader",
-               "dataset", "onnx")
+               "dataset", "onnx", "tensor")
 
 
 from ._legacy_api import *  # noqa: F401,F403  — v1/compat root names

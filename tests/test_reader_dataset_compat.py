@@ -72,3 +72,19 @@ def test_dataset_with_reader_pipeline():
         reader.firstn(dataset.uci_housing.train(), 32), 16), 4)
     xs = [x for x, _ in r()]
     assert len(xs) == 32
+
+
+def test_tensor_namespace_layout():
+    """paddle.tensor module layout parity (reference python/paddle/tensor/:
+    creation/manipulation/math/linalg/logic/random/search/stat)."""
+    import paddle_tpu.tensor as T
+    from paddle_tpu.tensor.creation import full
+    import paddle_tpu.tensor.math  # noqa: F401
+
+    out = full([2, 2], 3.0)
+    assert np.asarray(out.numpy()).tolist() == [[3.0, 3.0], [3.0, 3.0]]
+    assert T.random.rand([3]).shape == (3,)
+    assert hasattr(T.search, "topk") and hasattr(T.stat, "mean")
+    assert hasattr(T, "manipulation") and hasattr(T, "linalg")
+    # functions also live flat on the namespace, as in the reference
+    assert hasattr(T, "concat") and hasattr(T, "matmul")
