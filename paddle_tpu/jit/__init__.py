@@ -185,9 +185,9 @@ def save(layer, path, input_spec=None, **configs):
     # if save installed the converted forward itself, it removes it after —
     # export must not permanently mutate the caller's layer (a to_static-
     # wrapped layer keeps its conversion: the user opted in)
-    had_fwd = "forward" in layer.__dict__
-    convert_layer(layer)
+    installed = []
     try:
+        convert_layer(layer, installed=installed)
         was_training = layer.training
         layer.eval()
         program = Program("inference")
@@ -235,8 +235,10 @@ def save(layer, path, input_spec=None, **configs):
         _save(layer.state_dict(), path + ".pdiparams")
         _export_stablehlo(layer, input_spec, [v.name for v in feeds], path)
     finally:
-        if not had_fwd:
-            layer.__dict__.pop("forward", None)
+        # export must not permanently mutate the caller's model: undo
+        # every instance-level forward the conversion installed
+        for lyr in installed:
+            lyr.__dict__.pop("forward", None)
 
 
 def _export_stablehlo(layer, input_spec, feed_names, path):

@@ -21,11 +21,13 @@ predicate's regime; the heavy lifting (sub-block tracing, shape-invariant
 checks) is the existing static control-flow layer and XLA itself.
 
 Restrictions (each falls back to untransformed Python, which still works
-for non-tensor predicates): `return`/`break`/`continue` inside a converted
-branch or loop body, `global`/`nonlocal` in the function, and functions
-whose source is unavailable. Calls into sub-layers are not recursively
-converted — decorate the sublayer's forward, or keep data-dependent flow in
-the top-level forward.
+for non-tensor predicates): bare `break`/`continue` inside a converted
+loop body (returns lift via the early-return fold), `global`/`nonlocal`
+in the function, and functions whose source is unavailable.
+`convert_layer` recurses into sublayers (the reference's convert_call),
+so control flow anywhere in a Layer call tree converts; plain helper
+FUNCTIONS called from a forward are not rewritten — decorate them with
+@to_static if they branch on tensors.
 """
 from __future__ import annotations
 
@@ -971,12 +973,27 @@ def _convert(fn):
     return new_fn
 
 
-def convert_layer(layer):
+def convert_layer(layer, recursive=True, installed=None):
     """Convert `layer`'s forward in place (instance-level override, so
-    hooks/recompute in Layer.__call__ still apply). Returns the layer."""
-    cls_fwd = type(layer).forward
-    conv = convert_function(cls_fwd)
-    if conv is not cls_fwd and "forward" not in layer.__dict__:
-        object.__setattr__(layer, "forward",
-                           types.MethodType(conv, layer))
+    hooks/recompute in Layer.__call__ still apply), and — like the
+    reference's convert_call (program_translator.py) — recurse into
+    sublayers so control flow anywhere in the call tree converts.
+    Conversion is semantics-preserving for concrete predicates, so
+    converting every forward is safe; per-class function results are
+    cached, so repeat conversions are free.
+
+    `installed`: optional list collecting every (sub)layer that received
+    an instance-level forward here — jit.save uses it to undo the
+    overrides after tracing so export does not permanently mutate the
+    caller's model."""
+    targets = (layer.sublayers(include_self=True) if recursive
+               else [layer])
+    for lyr in targets:
+        cls_fwd = type(lyr).forward
+        conv = convert_function(cls_fwd)
+        if conv is not cls_fwd and "forward" not in lyr.__dict__:
+            object.__setattr__(lyr, "forward",
+                               types.MethodType(conv, lyr))
+            if installed is not None:
+                installed.append(lyr)
     return layer

@@ -386,3 +386,59 @@ def test_elif_chain_save_load():
     for x, w in zip(xs, want):
         np.testing.assert_allclose(_np(loaded(paddle.to_tensor(x))), w,
                                    rtol=1e-5, atol=1e-5)
+
+
+class GatedBlock(nn.Layer):
+    """Control flow lives in a SUBLAYER's forward."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 4)
+
+    def forward(self, x):
+        h = self.fc(x)
+        if h.mean() > 0:
+            return h * 2.0
+        return h * 0.5
+
+
+class OuterNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.block = GatedBlock()
+        self.head = nn.Linear(4, 2)
+
+    def forward(self, x):
+        return self.head(self.block(x))
+
+
+def test_sublayer_control_flow_converts_and_saves():
+    """convert_layer recurses (reference convert_call): tensor branches in
+    sublayers convert for both to_static and jit.save; export leaves no
+    instance-forward overrides behind."""
+    paddle.seed(0)
+    net = OuterNet()
+    net.eval()
+    xs = [np.random.RandomState(0).randn(2, 4).astype("float32"),
+          -np.abs(np.random.RandomState(1).randn(2, 4)).astype("float32")
+          * 3.0]
+    want = [_np(net(paddle.to_tensor(x))) for x in xs]
+
+    st = jit.to_static(net)
+    for x, w in zip(xs, want):
+        np.testing.assert_allclose(_np(st(paddle.to_tensor(x))), w,
+                                   rtol=1e-5, atol=1e-6)
+
+    paddle.seed(0)
+    net2 = OuterNet()
+    net2.eval()
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "sub")
+    jit.save(net2, path, input_spec=[jit.InputSpec([2, 4], "float32", "x")])
+    # save undid every instance-level forward it installed
+    assert "forward" not in net2.__dict__
+    assert "forward" not in net2.block.__dict__
+    loaded = jit.load(path)
+    for x, w in zip(xs, want):
+        np.testing.assert_allclose(_np(loaded(paddle.to_tensor(x))), w,
+                                   rtol=1e-5, atol=1e-6)
