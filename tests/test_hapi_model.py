@@ -163,7 +163,10 @@ def test_model_engine_mode_independent():
     a clear error."""
     import paddle_tpu as paddle
     from paddle_tpu import io, nn, optimizer, static
+    from paddle_tpu.distributed import mesh as mesh_mod
 
+    prev_mesh = mesh_mod.get_mesh()
+    mesh_mod.reset_mesh()  # isolate from suites that leave a dp mesh
     net = nn.Linear(4, 2)
     m = paddle.Model(net)
     m.prepare(optimizer.SGD(learning_rate=0.1,
@@ -194,3 +197,5 @@ def test_model_engine_mode_independent():
             paddle.Model(nn.Linear(4, 2))
     finally:
         paddle.disable_static()
+        if prev_mesh is not None:
+            mesh_mod.set_mesh(prev_mesh)
