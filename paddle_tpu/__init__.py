@@ -79,7 +79,7 @@ from ._legacy_api import VarBase, LoDTensor, LoDTensorArray  # noqa: F401
 
 # Lazily-injected non-module names (see __getattr__); enumerated so the
 # API.spec snapshot is deterministic regardless of import order.
-__all_lazy__ = ("Model", "summary", "flops", "save", "load")
+__all_lazy__ = ("Model", "summary", "flops", "save", "load", "batch")
 
 
 def __getattr__(name):
@@ -99,4 +99,8 @@ def __getattr__(name):
         from .framework.io import load, save
         globals().update(save=save, load=load)
         return globals()[name]
+    if name == "batch":
+        from .reader import batch
+        globals()["batch"] = batch
+        return batch
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

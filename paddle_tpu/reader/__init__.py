@@ -14,7 +14,29 @@ import threading
 
 __all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
            "firstn", "xmap_readers", "cache", "multiprocess_reader",
-           "ComposeNotAligned"]
+           "ComposeNotAligned", "batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (reference
+    python/paddle/batch.py:18 — exposed at the paddle root as
+    paddle.batch)."""
+    batch_size = int(batch_size)
+    if batch_size <= 0:
+        raise ValueError(
+            "batch_size should be a positive integer value, "
+            f"but got batch_size={batch_size}")
+
+    def batch_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader
 
 
 class ComposeNotAligned(ValueError):

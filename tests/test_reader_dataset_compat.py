@@ -88,3 +88,14 @@ def test_tensor_namespace_layout():
     assert hasattr(T, "manipulation") and hasattr(T, "linalg")
     # functions also live flat on the namespace, as in the reference
     assert hasattr(T, "concat") and hasattr(T, "matmul")
+
+
+def test_paddle_batch_root_api():
+    """paddle.batch parity (reference python/paddle/batch.py:18)."""
+    def r():
+        yield from range(7)
+
+    batches = list(paddle.batch(r, 3)())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(paddle.batch(r, 3, drop_last=True)()) == \
+        [[0, 1, 2], [3, 4, 5]]
