@@ -67,6 +67,10 @@ define_flag("FLAGS_seed", 0, "global random seed")
 define_flag("FLAGS_log_level", 0, "verbose log level (glog VLOG equivalent)")
 define_flag("FLAGS_allocator_strategy", "xla", "kept for parity; XLA owns device memory")
 define_flag("FLAGS_enable_profiler", False, "enable host event profiler")
+define_flag("FLAGS_log_memory_estimate", False,
+            "on each fresh Executor lowering, run the liveness-based "
+            "peak-memory estimator (static/shape_infer.py analyze_memory) "
+            "and publish executor/estimated_peak_bytes to the monitor")
 define_flag("FLAGS_use_flash_attention", True,
             "route attention through the Pallas flash kernel on TPU "
             "(paddle_tpu.ops.pallas.flash_attention)")

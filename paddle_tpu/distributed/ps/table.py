@@ -169,7 +169,10 @@ class SparseTable:
         return len(self._index)
 
     def _ensure(self, ids):
-        missing = [i for i in ids if i not in self._index]
+        # dedupe while preserving first-seen order: a batch like
+        # [5, 9, 5] must materialize id 5 ONCE, or the duplicate would
+        # claim two rows and corrupt _index for every later id
+        missing = [i for i in dict.fromkeys(ids) if i not in self._index]
         if not missing:
             return
         base = len(self._index)

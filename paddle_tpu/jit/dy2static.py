@@ -929,6 +929,20 @@ def convert_function(fn):
 
 
 def _convert(fn):
+    """AST-rewrite `fn`'s control flow; returns the converted function.
+
+    Globals semantics (deliberate, pinned by
+    tests/test_dy2static.py::test_monkeypatch_after_convert): the
+    converted function executes against `fn.__globals__` ITSELF — the
+    live module dict, not a snapshot — so monkeypatching a module global
+    after conversion is seen by the converted function exactly as it
+    would be by the original (reference program_translator.py builds its
+    StaticFunction over the original function object for the same
+    reason). The `__jst__` helper module is therefore NOT injected into
+    the user's globals; it is passed as the first parameter of the
+    compiled factory, so the rewritten body resolves `__jst__` through
+    the factory's closure and a user global named `__jst__` is never
+    read nor shadowed (see docs/dy2static.md)."""
     if not isinstance(fn, types.FunctionType):
         return fn
     src = textwrap.dedent(inspect.getsource(fn))
@@ -949,10 +963,13 @@ def _convert(fn):
     if not tr.changed:
         return fn
     freevars = fn.__code__.co_freevars
+    if "__jst__" in freevars:
+        return fn  # would collide with the helper parameter; run as-is
     factory = ast.FunctionDef(
         name="__jst_factory__",
         args=ast.arguments(posonlyargs=[],
-                           args=[ast.arg(arg=v) for v in freevars],
+                           args=[ast.arg(arg="__jst__")]
+                           + [ast.arg(arg=v) for v in freevars],
                            kwonlyargs=[], kw_defaults=[], defaults=[]),
         body=[fdef, ast.Return(value=_name(fdef.name))],
         decorator_list=[])
@@ -960,12 +977,12 @@ def _convert(fn):
     ast.fix_missing_locations(mod)
     code = compile(mod, f"<dy2static:{getattr(fn, '__qualname__', '?')}>",
                    "exec")
-    glb = dict(fn.__globals__)
-    glb["__jst__"] = sys.modules[__name__]
+    # exec against the LIVE globals (separate locals keep __jst_factory__
+    # out of the user's module namespace)
     ns = {}
-    exec(code, glb, ns)
+    exec(code, fn.__globals__, ns)
     cells = [c.cell_contents for c in (fn.__closure__ or ())]
-    new_fn = ns["__jst_factory__"](*cells)
+    new_fn = ns["__jst_factory__"](sys.modules[__name__], *cells)
     new_fn.__defaults__ = fn.__defaults__
     new_fn.__kwdefaults__ = fn.__kwdefaults__
     functools.update_wrapper(new_fn, fn, updated=())

@@ -19,14 +19,25 @@ from ..core.tensor import Tensor
 
 OP_REGISTRY = {}
 
+# op_name -> abstract shape/dtype rule, consulted by
+# paddle_tpu.static.shape_infer before falling back to jax.eval_shape.
+# A rule takes the op's inputs with every tensor replaced by a
+# jax.ShapeDtypeStruct (literals pass through) and returns the output
+# aval(s); it raises ValueError on ill-formed inputs.
+SHAPE_INFER_REGISTRY = {}
 
-def defop(raw_fn=None, *, name=None, version=1):
+
+def defop(raw_fn=None, *, name=None, version=1, infer=None):
     """Lift a raw jnp function into a Tensor-level differentiable op.
 
     `version` is the op's schema version recorded into saved models
     (reference framework.proto:186 op-version map; checked on load by
     framework/program_serde.py). Bump it when an op's attrs or semantics
-    change incompatibly."""
+    change incompatibly.
+
+    `infer` optionally registers an abstract shape/dtype rule for the op
+    (the compile-time InferShape analog, framework/op_desc.cc); ops
+    without one are inferred through `jax.eval_shape` on the kernel."""
     def deco(f):
         opname = name or f.__name__.lstrip("_")
 
@@ -40,6 +51,8 @@ def defop(raw_fn=None, *, name=None, version=1):
         f.op_name = opname  # lets recorded Programs serialize ops by name
         f.op_version = int(version)
         OP_REGISTRY[opname] = wrapper
+        if infer is not None:
+            SHAPE_INFER_REGISTRY[opname] = infer
         return wrapper
 
     return deco(raw_fn) if raw_fn is not None else deco

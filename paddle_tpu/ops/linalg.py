@@ -17,7 +17,43 @@ import jax.numpy as jnp
 from ._dispatch import defop
 
 
-@defop
+def _matmul_infer(x, y, transpose_x=False, transpose_y=False):
+    """Abstract rule (registered alongside @defop): catches rank and
+    contraction-dim errors at Program build/verify time with a named
+    diagnostic instead of an XLA trace error."""
+    import numpy as np
+    xs, ys = list(x.shape), list(y.shape)
+    if not xs or not ys:
+        raise ValueError(
+            f"matmul requires rank >= 1 operands, got {tuple(x.shape)} @ "
+            f"{tuple(y.shape)}")
+    if transpose_x and len(xs) > 1:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if transpose_y and len(ys) > 1:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    vec_x = len(xs) == 1
+    vec_y = len(ys) == 1
+    if vec_x:
+        xs = [1] + xs
+    if vec_y:
+        ys = ys + [1]
+    if xs[-1] != ys[-2]:
+        raise ValueError(
+            f"matmul contraction mismatch: {tuple(x.shape)} @ "
+            f"{tuple(y.shape)} contracts {xs[-1]} against {ys[-2]}"
+            + (" (with transpose flags applied)"
+               if transpose_x or transpose_y else ""))
+    batch = np.broadcast_shapes(tuple(xs[:-2]), tuple(ys[:-2]))
+    out = list(batch) + [xs[-2], ys[-1]]
+    if vec_y:
+        out = out[:-1]
+    if vec_x:
+        out = out[:-2] + out[-1:] if not vec_y else out[:-1]
+    return jax.ShapeDtypeStruct(tuple(out),
+                                jnp.result_type(x.dtype, y.dtype))
+
+
+@defop(infer=_matmul_infer)
 def matmul(x, y, transpose_x=False, transpose_y=False):
     if transpose_x:
         x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x

@@ -662,10 +662,15 @@ def cvm(x, cvm_in=None, use_cvm=True):
     return rest
 
 
-@defop
+@defop(version=2)
 def hash_bucket(x, num_hash=1, mod_by=100000007):
     """reference hash_op.cc: ids -> num_hash bucket ids (multiplicative
-    hashing with distinct seeds)."""
+    hashing with distinct seeds).
+
+    version 2: buckets are masked non-negative before the modulo (v1
+    could emit negative bucket ids on int64 wraparound); artifacts saved
+    by this build refuse to load into v1 frameworks via program_serde's
+    op-version check."""
     ids = x.astype(jnp.int64)
     seeds = jnp.asarray([(0x9E3779B1 * (i + 1)) | 1
                          for i in range(num_hash)], jnp.int64)

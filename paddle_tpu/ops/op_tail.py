@@ -90,18 +90,25 @@ def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12):
     return jnp.moveaxis(out.reshape(w.shape), 0, dim)
 
 
-@defop
+@defop(version=2)
 def beam_search(pre_ids, pre_scores, scores, beam_size, end_id,
                 is_accumulated=True):
     """reference beam_search_op.cc, batched-dense: one step of beam
-    expansion. pre_ids/pre_scores [B, K]; scores [B, K, V] (log-probs of
-    the candidate step, already accumulated when is_accumulated). Returns
+    expansion. pre_ids/pre_scores [B, K]; scores [B, K, V] — accumulated
+    log-probs when is_accumulated, else NORMALIZED probabilities of the
+    candidate step (the reference contract: beam_search_op.cc applies
+    std::log to them before adding pre_scores). Returns
     (selected_ids [B, K], selected_scores [B, K], parent_idx [B, K]).
     Finished lanes (pre_id == end_id) emit end_id with their score frozen,
-    matching the reference's finished-branch handling."""
+    matching the reference's finished-branch handling.
+
+    version 2: is_accumulated=False now applies jnp.log per that
+    contract (v1 wrongly re-normalized via log_softmax, treating the
+    probabilities as logits); the bump makes program_serde refuse
+    replaying v2 artifacts on v1 builds."""
     b, k, vsz = scores.shape
     if not is_accumulated:
-        scores = pre_scores[:, :, None] + jax.nn.log_softmax(scores, -1)
+        scores = pre_scores[:, :, None] + jnp.log(scores)
     finished = (pre_ids == end_id)
     # a finished lane contributes exactly one candidate: end_id at its
     # frozen score; mask the rest of its row to -inf

@@ -19,6 +19,9 @@ from .program import (Program, Variable, StaticParam, default_main_program,  # n
                       default_startup_program, disable_static_,
                       enable_static_, global_scope, in_static_mode,
                       name_scope, program_guard)
+from .shape_infer import (ShapeInferError, analyze_memory,  # noqa: F401
+                          infer_program, register_infer_rule)
+from .verifier import ProgramVerifyError, verify_program  # noqa: F401
 
 __all__ = ["data", "InputSpec", "Program", "Variable", "Executor",
            "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
@@ -26,7 +29,9 @@ __all__ = ["data", "InputSpec", "Program", "Variable", "Executor",
            "default_startup_program", "global_scope", "append_backward",
            "gradients", "save", "load", "set_program_state", "nn",
            "save_inference_model", "load_inference_model",
-           "cpu_places", "cuda_places"]
+           "cpu_places", "cuda_places",
+           "verify_program", "ProgramVerifyError", "infer_program",
+           "ShapeInferError", "register_infer_rule", "analyze_memory"]
 
 
 def data(name, shape, dtype="float32", lod_level=0):
@@ -131,8 +136,6 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
     import jax.export as jexport
     import jax.numpy as jnp
 
-    from .passes import eliminate_dead_ops
-
     if program is None:  # the graph the fetches live in, not the ambient
         program = next((v.program for v in fetch_vars
                         if getattr(v, "program", None) is not None),
@@ -145,8 +148,10 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
     # into the artifact, or the lowered step would demand label feeds
     prog.backward_section = None
     prog.optimizer_section = None
-    from .passes import fold_constants
-    pruned = fold_constants(eliminate_dead_ops(prog))
+    # through apply_pass so the pass-safety harness (verify-before/after
+    # under PADDLE_TPU_VERIFY_PASSES) covers the export path too
+    from .passes import apply_pass
+    pruned = apply_pass(prog, ["eliminate_dead_ops", "fold_constants"])
 
     feed_names = [v.name for v in feed_vars]
     # versioned schema format (framework/program_serde.py) with pickle

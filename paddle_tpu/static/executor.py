@@ -118,6 +118,12 @@ class Executor:
                                       data_parallel)
             self._cache[key] = entry
             _monitor.stat_add("executor/lowerings")
+            from ..core import flags as _flags0
+            if _flags0.flag("FLAGS_log_memory_estimate"):
+                from .shape_infer import analyze_memory
+                est = analyze_memory(program)
+                _monitor.stat_set("executor/estimated_peak_bytes",
+                                  est["peak_bytes"])
         step, persist_names, opt, amp_init = entry
 
         for n, v0 in (amp_init or {}).items():

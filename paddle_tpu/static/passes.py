@@ -9,14 +9,43 @@ Passes run on the flat SSA op list; registration mirrors ir::PassRegistry.
 """
 from __future__ import annotations
 
+import os
 from typing import Callable, Dict
 
 from .program import Program, _Ref
 
 __all__ = ["Pass", "register_pass", "get_pass", "apply_pass",
-           "eliminate_dead_ops", "fold_constants", "graph_viz"]
+           "eliminate_dead_ops", "fold_constants", "graph_viz",
+           "verify_passes_enabled", "set_verify_passes"]
 
 _PASS_REGISTRY: Dict[str, Callable] = {}
+
+# -- pass-safety harness ------------------------------------------------------
+# Every pass applied through apply_pass() runs verify-before/verify-after
+# (static/verifier.py) when enabled, so a pass that corrupts def-use
+# chains fails AT THE REWRITE with a ProgramVerifyError naming the pass —
+# not as a wrong number at Executor.run time. Controlled by the
+# PADDLE_TPU_VERIFY_PASSES env var (default on under pytest via
+# tests/conftest.py; off in production, where passes are trusted and the
+# check is pure overhead) or set_verify_passes().
+
+_verify_override = None
+
+
+def verify_passes_enabled() -> bool:
+    if _verify_override is not None:
+        return _verify_override
+    return os.environ.get("PADDLE_TPU_VERIFY_PASSES", "0").strip().lower() \
+        not in ("0", "false", "off", "")
+
+
+def set_verify_passes(enabled):
+    """Force the harness on/off from code (None restores the env-var
+    default); returns the previous override."""
+    global _verify_override
+    old = _verify_override
+    _verify_override = None if enabled is None else bool(enabled)
+    return old
 
 
 class Pass:
@@ -48,8 +77,25 @@ def get_pass(name):
 def apply_pass(program, names):
     if isinstance(names, str):
         names = [names]
-    for n in names:
+    verify = verify_passes_enabled()
+    if verify:
+        from .verifier import verify_program
+        verify_program(program)  # a pre-broken input is the CALLER's bug
+    for idx, n in enumerate(names):
         program = get_pass(n)(program)
+        if not isinstance(program, Program):
+            # analysis passes (graph_viz) return artifacts, not Programs:
+            # legal only as the LAST pass — feeding an artifact into the
+            # next pass would crash far from the cause
+            if idx != len(names) - 1:
+                raise TypeError(
+                    f"pass '{n}' returned {type(program).__name__}, not a "
+                    f"Program — analysis passes must come last in the "
+                    f"chain {list(names)}")
+            break
+        if verify:
+            from .verifier import verify_program
+            verify_program(program, pass_name=n)
     return program
 
 

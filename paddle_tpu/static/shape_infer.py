@@ -1,0 +1,524 @@
+"""Abstract shape/dtype propagation over static Programs.
+
+The compile-time InferShape analog (reference framework/op_desc.cc
+InferShape + operators/*_op.cc InferShape methods, run while building the
+ProgramDesc): walk the op list propagating `jax.ShapeDtypeStruct` avals,
+using per-op rules registered alongside `@defop`
+(paddle_tpu/ops/_dispatch.py SHAPE_INFER_REGISTRY) and falling back to
+`jax.eval_shape` on the op's kernel. Mismatches (a rewritten matmul whose
+contraction dims no longer agree, a dtype-promotion surprise, an AMP
+fp16/fp32 boundary violation) surface at build/verify time as
+`ShapeInferError` naming the op and variable — not as an XLA trace error
+at Executor.run time.
+
+The propagated avals also feed `analyze_memory(program)`: a liveness-
+based peak-memory estimator (reference memory_optimize_pass liveness
+analysis, ir/memory_optimize_pass/memory_optimization_var_info.h) used by
+the Executor (FLAGS_log_memory_estimate) and tools/pp_schedule_report.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._dispatch import SHAPE_INFER_REGISTRY
+from .program import Program, _Ref
+
+__all__ = ["ShapeInferError", "register_infer_rule", "infer_program",
+           "analyze_memory", "SHAPE_INFER_REGISTRY"]
+
+
+class ShapeInferError(RuntimeError):
+    """Shape/dtype propagation found an inconsistency.
+
+    `op_name`/`op_index` name the offending op, `var` the output variable
+    (when the failure is a recorded-vs-inferred mismatch).
+    """
+
+    def __init__(self, message, *, op_name=None, op_index=None, var=None):
+        self.op_name = op_name
+        self.op_index = op_index
+        self.var = var
+        where = ""
+        if op_name is not None:
+            where = f" [op #{op_index} '{op_name}']" \
+                if op_index is not None else f" [op '{op_name}']"
+        super().__init__(f"shape-infer{where}: {message}")
+
+
+def register_infer_rule(*names):
+    """Register an abstract rule for the named ops (the decorator form of
+    `@defop(infer=...)` for rules shared across an op family)."""
+    def deco(fn):
+        for n in names:
+            SHAPE_INFER_REGISTRY[n] = fn
+        return fn
+    return deco
+
+
+def _aval_of(x):
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    return x
+
+
+def _is_aval(x):
+    return isinstance(x, jax.ShapeDtypeStruct)
+
+
+def _nbytes(aval) -> int:
+    return int(np.prod(aval.shape, dtype=np.int64)) \
+        * np.dtype(aval.dtype).itemsize if aval.shape \
+        else np.dtype(aval.dtype).itemsize
+
+
+# ---------------------------------------------------------------------------
+# propagation
+# ---------------------------------------------------------------------------
+
+def _seed_env(program: Program) -> Dict[int, jax.ShapeDtypeStruct]:
+    env = {}
+    for v in program.data_vars.values():
+        env[v.var_id] = v.aval
+    for scope_name, vid in program.persist_ids.items():
+        pv = program.persistable_vars.get(scope_name)
+        if pv is not None:
+            env[vid] = pv.aval
+    return env
+
+
+def _fallback_eval_shape(op, in_vals, kw_tree, n_args):
+    """Record-time inference replayed: jax.eval_shape over the kernel with
+    the PRNG chain sandboxed (tape._record_static does the same)."""
+    import jax.tree_util as jtu
+    from ..core import rng as _rng
+
+    dyn_idx = [i for i, v in enumerate(in_vals) if _is_aval(v)]
+
+    def call(*dyn):
+        vals = list(in_vals)
+        for i, v in zip(dyn_idx, dyn):
+            vals[i] = v
+        kw = jtu.tree_unflatten(kw_tree, vals[n_args:])
+        return op.fn(*vals[:n_args], **kw)
+
+    with _rng.rng_state(jax.random.PRNGKey(0)):
+        return jax.eval_shape(call, *[in_vals[i] for i in dyn_idx])
+
+
+def _apply_rule(rule, op, in_vals, kw_tree, n_args):
+    import jax.tree_util as jtu
+    kw = jtu.tree_unflatten(kw_tree, in_vals[n_args:])
+    return rule(*in_vals[:n_args], **kw)
+
+
+def _amp_cast(program, op_name, in_vals):
+    """Mirror the Executor's program-level AMP cast (executor.py
+    cast_vals) on avals, and report gray-zone mixed-precision inputs —
+    the fp16/fp32 boundary mismatches AMP O1 silently promotes."""
+    from .. import amp as amp_mod
+    level = program.amp_level
+    dtype = getattr(program, "amp_dtype", jnp.bfloat16)
+    white, black = getattr(program, "amp_lists", (None, None))
+    dt = amp_mod.policy_dtype(op_name, level, dtype, white, black)
+    float_dtypes = {np.dtype(v.dtype) for v in in_vals if _is_aval(v)
+                    and jnp.issubdtype(v.dtype, jnp.floating)}
+    mixed = len(float_dtypes) > 1
+    if dt is None:
+        return in_vals, mixed, float_dtypes
+    out = [jax.ShapeDtypeStruct(v.shape, dt)
+           if _is_aval(v) and jnp.issubdtype(v.dtype, jnp.floating)
+           and np.dtype(v.dtype) != np.dtype(dt) else v
+           for v in in_vals]
+    return out, False, float_dtypes
+
+
+def infer_program(program: Program, check: bool = True,
+                  amp_check: bool = True) -> Dict[int, jax.ShapeDtypeStruct]:
+    """Propagate avals through the program; returns {var_id: aval}.
+
+    check=True compares each op's inferred output avals against the
+    recorded ones (shape always; dtype unless program-level AMP rewrites
+    dtypes at lowering time) and raises `ShapeInferError` on mismatch.
+    amp_check=True additionally flags fp16/fp32 boundary violations for
+    AMP-tagged programs: a gray-list op receiving mixed float dtypes
+    would silently promote — exactly the surprise AMP O1 is supposed to
+    make deliberate.
+    """
+    env = _seed_env(program)
+    amp_on = bool(getattr(program, "amp_level", None))
+    violations: List[str] = []
+    for i, op in enumerate(program.ops):
+        in_vals = []
+        for x in op.flat:
+            if isinstance(x, _Ref):
+                if x.var_id not in env:
+                    raise ShapeInferError(
+                        f"input '{x.name}' (id {x.var_id}) has no known "
+                        "aval — the program is structurally broken (run "
+                        "verify_program for the structural diagnosis)",
+                        op_name=op.name, op_index=i, var=x.name)
+                in_vals.append(env[x.var_id])
+            else:
+                in_vals.append(_aval_of(x))
+        if amp_on:
+            in_vals, mixed, float_dtypes = _amp_cast(program, op.name,
+                                                     in_vals)
+            if mixed and amp_check:
+                violations.append(
+                    f"op #{i} '{op.name}' mixes float dtypes "
+                    f"{sorted(str(d) for d in float_dtypes)} in the AMP "
+                    "gray zone — the promotion is silent; add the op to a "
+                    "white/black list or cast explicitly")
+        rule = SHAPE_INFER_REGISTRY.get(op.name)
+        try:
+            if rule is not None:
+                out = _apply_rule(rule, op, in_vals, op.kw_tree, op.n_args)
+            else:
+                out = _fallback_eval_shape(op, in_vals, op.kw_tree,
+                                           op.n_args)
+        except ShapeInferError:
+            raise
+        except Exception as e:
+            raise ShapeInferError(str(e), op_name=op.name,
+                                  op_index=i) from e
+        avals = list(out) if isinstance(out, (tuple, list)) else [out]
+        avals = [_aval_of(a) for a in avals]
+        if len(avals) != len(op.out_ids):
+            raise ShapeInferError(
+                f"kernel yields {len(avals)} outputs but the op records "
+                f"{len(op.out_ids)}", op_name=op.name, op_index=i)
+        for aval, oid, ovar in zip(avals, op.out_ids, op.out_vars):
+            if check:
+                rec = ovar.aval
+                if tuple(aval.shape) != tuple(rec.shape):
+                    raise ShapeInferError(
+                        f"output '{ovar.name}' records shape "
+                        f"{tuple(rec.shape)} but propagation infers "
+                        f"{tuple(aval.shape)}", op_name=op.name,
+                        op_index=i, var=ovar.name)
+                if not amp_on and np.dtype(aval.dtype) != np.dtype(rec.dtype):
+                    raise ShapeInferError(
+                        f"output '{ovar.name}' records dtype {rec.dtype} "
+                        f"but propagation infers {aval.dtype}",
+                        op_name=op.name, op_index=i, var=ovar.name)
+            env[oid] = aval
+    if violations:
+        raise ShapeInferError("AMP boundary check failed:\n  "
+                              + "\n  ".join(violations))
+    return env
+
+
+# ---------------------------------------------------------------------------
+# liveness-based peak-memory estimate
+# ---------------------------------------------------------------------------
+
+def analyze_memory(program: Program,
+                   env: Optional[dict] = None) -> dict:
+    """Estimate the lowered step's peak residency from inferred avals.
+
+    Liveness at the Program level (the reference's
+    memory_optimize_pass var lifetime analysis): an intermediate is live
+    from the op that defines it until its last reader — or to the end of
+    the program when it is fetched, state-written, or feeds the backward
+    section. Persistables (params) and feeds are resident throughout.
+
+    Returns {"peak_bytes", "param_bytes", "feed_bytes",
+    "activation_peak_bytes", "timeline": [(op_name, live_bytes)],
+    "peak_op"}; a pure estimate — XLA's buffer assignment (fusion,
+    rematerialization, donation) can only shrink it.
+    """
+    if env is None:
+        env = infer_program(program, check=False, amp_check=False)
+    param_bytes = 0
+    for scope_name, vid in program.persist_ids.items():
+        pv = program.persistable_vars.get(scope_name)
+        if pv is not None:
+            param_bytes += _nbytes(pv.aval)
+    feed_bytes = sum(_nbytes(v.aval) for v in program.data_vars.values())
+
+    n = len(program.ops)
+    roots = set(program.state_writes.values())
+    if program.backward_section is not None:
+        loss, pairs = program.backward_section
+        roots.add(loss.var_id)
+    for v in getattr(program, "_jit_fetch_vars", []) or []:
+        roots.add(v.var_id)
+
+    last_use: Dict[int, int] = {}
+    defined_at: Dict[int, int] = {}
+    for i, op in enumerate(program.ops):
+        for x in op.flat:
+            if isinstance(x, _Ref):
+                last_use[x.var_id] = i
+        for oid in op.out_ids:
+            defined_at[oid] = i
+    for vid in roots:
+        last_use[vid] = n  # pinned to the end of the step
+
+    timeline = []
+    peak = param_bytes + feed_bytes
+    peak_op = None
+    live_bytes = 0
+    live_now: Dict[int, int] = {}
+    for i, op in enumerate(program.ops):
+        for oid in op.out_ids:
+            if oid in env and last_use.get(oid, -1) >= i:
+                b = _nbytes(env[oid])
+                live_now[oid] = b
+                live_bytes += b
+        total = param_bytes + feed_bytes + live_bytes
+        timeline.append((op.name, total))
+        if total > peak:
+            peak, peak_op = total, (i, op.name)
+        # free vars whose last reader this op was (outputs freed above
+        # only after their own last use passes)
+        for vid in [v for v, last in list(live_now.items())
+                    if last_use.get(v, -1) <= i and v not in roots]:
+            live_bytes -= live_now.pop(vid)
+    return {"peak_bytes": int(peak),
+            "param_bytes": int(param_bytes),
+            "feed_bytes": int(feed_bytes),
+            "activation_peak_bytes": int(peak - param_bytes - feed_bytes),
+            "timeline": timeline,
+            "peak_op": peak_op}
+
+
+# ---------------------------------------------------------------------------
+# the built-in rule library (>= 25 ops). Rules are deliberately closed
+# forms — no tracing — so a rewritten program can be re-checked in
+# microseconds, and their error strings name the contract that broke.
+# ---------------------------------------------------------------------------
+
+def _result_dtype(*vals):
+    """jnp-style promotion over avals + python literals."""
+    parts = [v.dtype if _is_aval(v) else v for v in vals]
+    return jnp.result_type(*parts)
+
+
+def _default_float():
+    # respects the live jax_enable_x64 config (paddle_tpu turns it on)
+    return jnp.result_type(float)
+
+
+def _default_int():
+    return jnp.result_type(int)
+
+
+def _float_dtype(v):
+    """Unary float-math output dtype: floats pass through, ints promote
+    to the configured default float."""
+    dt = v.dtype if _is_aval(v) else jnp.result_type(v)
+    if jnp.issubdtype(dt, jnp.inexact):
+        return dt
+    return _default_float()
+
+
+@register_infer_rule("add", "subtract", "multiply", "maximum", "minimum")
+def _ew_binary(x, y, **kw):
+    xs = x.shape if _is_aval(x) else ()
+    ys = y.shape if _is_aval(y) else ()
+    try:
+        shape = np.broadcast_shapes(tuple(xs), tuple(ys))
+    except ValueError:
+        raise ValueError(
+            f"elementwise operands do not broadcast: {tuple(xs)} vs "
+            f"{tuple(ys)}") from None
+    return jax.ShapeDtypeStruct(shape, _result_dtype(x, y))
+
+
+@register_infer_rule("relu", "relu6", "leaky_relu", "silu", "gelu",
+                     "hardswish", "softplus")
+def _ew_unary_float(x, **kw):
+    shape = x.shape if _is_aval(x) else ()
+    return jax.ShapeDtypeStruct(tuple(shape), _float_dtype(x))
+
+
+@register_infer_rule("exp", "log", "sqrt", "sigmoid", "tanh")
+def _ew_unary_math(x, **kw):
+    shape = x.shape if _is_aval(x) else ()
+    return jax.ShapeDtypeStruct(tuple(shape), _float_dtype(x))
+
+
+@register_infer_rule("softmax", "log_softmax")
+def _softmax_rule(x, axis=-1, **kw):
+    nd = len(x.shape)
+    if not -nd <= axis < nd:
+        raise ValueError(f"softmax axis {axis} out of range for rank {nd}")
+    return jax.ShapeDtypeStruct(tuple(x.shape), _float_dtype(x))
+
+
+def _norm_axes(axis, nd):
+    if axis is None:
+        return tuple(range(nd))
+    axes = axis if isinstance(axis, (tuple, list)) else [axis]
+    out = []
+    for a in axes:
+        a = int(a)
+        if not -nd <= a < nd:
+            raise ValueError(f"reduce axis {a} out of range for rank {nd}")
+        out.append(a % nd if nd else 0)
+    return tuple(out)
+
+
+def _reduce_shape(x, axis, keepdim):
+    nd = len(x.shape)
+    axes = set(_norm_axes(axis, nd))
+    if keepdim:
+        return tuple(1 if i in axes else s for i, s in enumerate(x.shape))
+    return tuple(s for i, s in enumerate(x.shape) if i not in axes)
+
+
+@register_infer_rule("sum")
+def _sum_rule(x, axis=None, dtype=None, keepdim=False, **kw):
+    dt = jnp.dtype(dtype) if dtype is not None else (
+        _default_int() if jnp.issubdtype(x.dtype, jnp.bool_) else x.dtype)
+    return jax.ShapeDtypeStruct(_reduce_shape(x, axis, keepdim), dt)
+
+
+@register_infer_rule("prod")
+def _prod_rule(x, axis=None, keepdim=False, **kw):
+    dt = _default_int() if jnp.issubdtype(x.dtype, jnp.bool_) else x.dtype
+    return jax.ShapeDtypeStruct(_reduce_shape(x, axis, keepdim), dt)
+
+
+@register_infer_rule("mean")
+def _mean_rule(x, axis=None, keepdim=False, **kw):
+    return jax.ShapeDtypeStruct(_reduce_shape(x, axis, keepdim),
+                                _float_dtype(x))
+
+
+@register_infer_rule("max", "min")
+def _minmax_rule(x, axis=None, keepdim=False, **kw):
+    return jax.ShapeDtypeStruct(_reduce_shape(x, axis, keepdim), x.dtype)
+
+
+@register_infer_rule("all", "any")
+def _bool_reduce_rule(x, axis=None, keepdim=False, **kw):
+    return jax.ShapeDtypeStruct(_reduce_shape(x, axis, keepdim),
+                                jnp.dtype(jnp.bool_))
+
+
+@register_infer_rule("reshape")
+def _reshape_rule(x, shape, **kw):
+    size = int(np.prod(x.shape, dtype=np.int64))
+    shape = [int(s) for s in shape]
+    if shape.count(-1) > 1:
+        raise ValueError(f"reshape shape {shape} has more than one -1")
+    if -1 in shape:
+        rest = int(np.prod([s for s in shape if s != -1], dtype=np.int64))
+        if rest == 0 or size % rest:
+            raise ValueError(
+                f"cannot infer -1 in reshape {tuple(x.shape)} -> {shape}")
+        shape[shape.index(-1)] = size // rest
+    if int(np.prod(shape, dtype=np.int64)) != size:
+        raise ValueError(
+            f"reshape size mismatch: {tuple(x.shape)} ({size} elements) "
+            f"-> {tuple(shape)}")
+    return jax.ShapeDtypeStruct(tuple(shape), x.dtype)
+
+
+@register_infer_rule("transpose")
+def _transpose_rule(x, perm=None, **kw):
+    nd = len(x.shape)
+    if perm is None:
+        perm = list(range(nd))[::-1]
+    if sorted(int(p) % nd if nd else 0 for p in perm) != list(range(nd)):
+        raise ValueError(
+            f"transpose perm {list(perm)} is not a permutation of rank "
+            f"{nd}")
+    return jax.ShapeDtypeStruct(tuple(x.shape[int(p)] for p in perm),
+                                x.dtype)
+
+
+@register_infer_rule("concat")
+def _concat_rule(*xs, axis=0, **kw):
+    # recorded as concat(*xs, axis=...) through _concat's star args
+    avals = [v for v in xs if _is_aval(v)]
+    if not avals:
+        raise ValueError("concat needs at least one tensor input")
+    nd = len(avals[0].shape)
+    ax = int(axis) % nd if nd else 0
+    base = list(avals[0].shape)
+    total = 0
+    for v in avals:
+        if len(v.shape) != nd:
+            raise ValueError(
+                f"concat rank mismatch: {tuple(avals[0].shape)} vs "
+                f"{tuple(v.shape)}")
+        for i, (a, b) in enumerate(zip(base, v.shape)):
+            if i != ax and a != b:
+                raise ValueError(
+                    f"concat dim {i} mismatch: {tuple(avals[0].shape)} vs "
+                    f"{tuple(v.shape)} (axis={ax})")
+        total += v.shape[ax]
+    base[ax] = total
+    return jax.ShapeDtypeStruct(tuple(base), _result_dtype(*avals))
+
+
+@register_infer_rule("cast")
+def _cast_rule(x, dtype, **kw):
+    from ..core.dtype import to_jax_dtype
+    return jax.ShapeDtypeStruct(tuple(x.shape), to_jax_dtype(dtype))
+
+
+@register_infer_rule("one_hot")
+def _one_hot_rule(x, num_classes, **kw):
+    return jax.ShapeDtypeStruct(tuple(x.shape) + (int(num_classes),),
+                                _default_float())
+
+
+@register_infer_rule("embedding")
+def _embedding_rule(weight, ids, padding_idx=None, sparse=False, **kw):
+    if len(weight.shape) != 2:
+        raise ValueError(
+            f"embedding weight must be [vocab, dim], got "
+            f"{tuple(weight.shape)}")
+    return jax.ShapeDtypeStruct(tuple(ids.shape) + (weight.shape[1],),
+                                weight.dtype)
+
+
+@register_infer_rule("conv2d")
+def _conv2d_rule(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                 groups=1, data_format="NCHW", **kw):
+    if len(x.shape) != 4 or len(weight.shape) != 4:
+        raise ValueError(
+            f"conv2d wants 4-D input and weight, got {tuple(x.shape)} and "
+            f"{tuple(weight.shape)}")
+    if np.dtype(x.dtype) != np.dtype(weight.dtype):
+        raise ValueError(
+            f"conv2d input dtype {x.dtype} != weight dtype {weight.dtype}")
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    dil = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    if data_format == "NCHW":
+        n, cin, h, w = x.shape
+    else:
+        n, h, w, cin = x.shape
+    cout, cin_w, kh, kw_ = weight.shape
+    if cin_w * int(groups) != cin:
+        raise ValueError(
+            f"conv2d channel mismatch: input has {cin} channels but "
+            f"weight expects {cin_w} x groups={groups}")
+    if isinstance(padding, str):
+        if padding.upper() == "SAME":
+            oh = -(-h // st[0])
+            ow = -(-w // st[1])
+        else:  # VALID
+            oh = (h - dil[0] * (kh - 1) - 1) // st[0] + 1
+            ow = (w - dil[1] * (kw_ - 1) - 1) // st[1] + 1
+    else:
+        ph, pw = (padding, padding) if isinstance(padding, int) \
+            else tuple(padding)[:2]
+        oh = (h + 2 * ph - dil[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (w + 2 * pw - dil[1] * (kw_ - 1) - 1) // st[1] + 1
+    if oh <= 0 or ow <= 0:
+        raise ValueError(
+            f"conv2d output collapses to {oh}x{ow} for input {h}x{w}, "
+            f"kernel {kh}x{kw_}, stride {st}, padding {padding}")
+    shape = (n, cout, oh, ow) if data_format == "NCHW" \
+        else (n, oh, ow, cout)
+    return jax.ShapeDtypeStruct(shape, x.dtype)

@@ -8,11 +8,19 @@ host-platform device-count spoofing gives us 8 "chips" in-process instead.
 import os
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# pass-safety harness (static/passes.py): every Program pass runs
+# verify-before/verify-after in tests, so a pass bug fails at the rewrite
+os.environ.setdefault("PADDLE_TPU_VERIFY_PASSES", "1")
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer jax spells the host-device spoof as a config option; older
+    # builds only understand the XLA_FLAGS form set above
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 import pytest  # noqa: E402
 

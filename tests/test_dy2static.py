@@ -442,3 +442,36 @@ def test_sublayer_control_flow_converts_and_saves():
     for x, w in zip(xs, want):
         np.testing.assert_allclose(_np(loaded(paddle.to_tensor(x))), w,
                                    rtol=1e-5, atol=1e-6)
+
+
+# module global used by test_monkeypatch_after_convert
+_GLOBAL_SCALE = 2.0
+
+
+def _scaled_branch(x):
+    if x.mean() > 0:
+        y = x * _GLOBAL_SCALE
+    else:
+        y = x - _GLOBAL_SCALE
+    return y
+
+
+def test_monkeypatch_after_convert():
+    """Pins the chosen globals semantics (docs/dy2static.md): _convert
+    execs against the LIVE fn.__globals__, so monkeypatching a module
+    global after conversion is observed by the converted function — and
+    the __jst__ helper never leaks into this module's namespace."""
+    global _GLOBAL_SCALE
+    conv = convert_function(_scaled_branch)
+    assert conv is not _scaled_branch
+    x = paddle.to_tensor(np.ones((2, 2), "float32"))
+    np.testing.assert_allclose(_np(conv(x)), np.full((2, 2), 2.0))
+    old = _GLOBAL_SCALE
+    try:
+        _GLOBAL_SCALE = 5.0
+        np.testing.assert_allclose(_np(conv(x)), np.full((2, 2), 5.0))
+    finally:
+        _GLOBAL_SCALE = old
+    # collision safety: conversion must not plant helpers in user globals
+    assert "__jst__" not in globals()
+    assert "__jst_factory__" not in globals()

@@ -1,0 +1,108 @@
+"""framework_lint in-process (ISSUE 1): the repo itself must be clean
+(this test IS the tier-1 invocation of the lint), and seeded fixtures
+with a registry/API.spec drift and a tracer-concretization hazard must
+each produce violations."""
+import json
+import os
+import sys
+import tempfile
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import framework_lint  # noqa: E402
+
+
+def test_repo_is_clean():
+    problems = framework_lint.run_lint()
+    assert problems == [], "\n".join(problems)
+    assert framework_lint.main([]) == 0
+
+
+def test_registry_spec_drift_detected():
+    with tempfile.TemporaryDirectory() as tmp:
+        # a spec that lost hash_bucket and carries a dead MISSING entry
+        spec = os.path.join(tmp, "API.spec")
+        with open(os.path.join(REPO, "API.spec")) as f:
+            lines = [ln for ln in f
+                     if not ln.split(" ", 1)[0].endswith(".hash_bucket")]
+        lines.append("paddle_tpu.gone_op MISSING\n")
+        with open(spec, "w") as f:
+            f.writelines(lines)
+        problems = framework_lint.check_registry_spec(
+            spec, framework_lint.VERSIONS_PATH)
+        assert any("hash_bucket" in p and "absent from API.spec" in p
+                   for p in problems)
+        assert any("MISSING" in p for p in problems)
+
+
+def test_version_drift_detected():
+    with tempfile.TemporaryDirectory() as tmp:
+        with open(framework_lint.VERSIONS_PATH) as f:
+            snap = json.load(f)
+        # signature changed without a version bump
+        snap["matmul"] = {"version": snap["matmul"]["version"],
+                         "sig": "(x, y, old_flag=False)"}
+        # and a version regression: snapshot is ahead of the live @defop
+        snap["relu"] = {"version": 99, "sig": snap["relu"]["sig"]}
+        # and a stale snapshot: live beam_search is v2, snapshot says v1
+        snap["beam_search"] = {"version": 1, "sig": snap["beam_search"]["sig"]}
+        # and a stale entry for a removed op
+        snap["op_that_was_deleted"] = {"version": 1, "sig": "(x)"}
+        vpath = os.path.join(tmp, "OP_VERSIONS.json")
+        with open(vpath, "w") as f:
+            json.dump(snap, f)
+        problems = framework_lint.check_registry_spec(
+            framework_lint.SPEC_PATH, vpath)
+        assert any("matmul" in p and "without a version bump" in p
+                   for p in problems)
+        assert any("relu" in p and "regressed" in p for p in problems)
+        assert any("beam_search" in p and "still records v1" in p
+                   for p in problems)
+        assert any("op_that_was_deleted" in p and "no longer registered"
+                   in p for p in problems)
+
+
+def test_concretization_hazards_detected_and_pragma_suppresses():
+    src = textwrap.dedent("""
+        import jax.numpy as jnp
+        from paddle_tpu.ops._dispatch import defop
+
+        @defop
+        def bad_branch(x, axis=0):
+            y = jnp.exp(x)
+            if x > 0:                      # hazard: if on traced value
+                y = y * 2
+            return y
+
+        @defop
+        def bad_concretize(x):
+            s = jnp.sum(x)
+            n = float(x)                   # hazard: float() of traced
+            return s.item() + n            # hazard: .item()
+
+        @defop
+        def fine_op(x, mode="a"):
+            if mode == "a":                # static attr: fine
+                return jnp.exp(x)
+            if x.ndim == 2:                # metadata: fine
+                return jnp.log(x)
+            return jnp.sqrt(x)
+
+        @defop
+        def waived(x):
+            if x > 0:  # lint: concretization-ok
+                return jnp.exp(x)
+            return x
+    """)
+    with tempfile.TemporaryDirectory() as tmp:
+        with open(os.path.join(tmp, "fixture_ops.py"), "w") as f:
+            f.write(src)
+        hits = framework_lint.check_concretization(tmp)
+    joined = "\n".join(hits)
+    assert "bad_branch" in joined and "`if` on traced" in joined
+    assert "bad_concretize" in joined and "`float()`" in joined
+    assert ".item()" in joined
+    assert "fine_op" not in joined
+    assert "waived" not in joined

@@ -208,3 +208,29 @@ def test_detection_map_metric():
     assert 0.4 < m.accumulate() < 0.75
     m.reset()
     assert m.accumulate() == 0.0
+
+
+def test_beam_search_unaccumulated_probabilities():
+    """ADVICE r05: is_accumulated=False takes NORMALIZED probabilities
+    (reference beam_search_op.cc applies std::log, not log_softmax).
+    Hand-computed: total[b,k,v] = pre_scores[b,k] + log(probs[b,k,v])."""
+    probs = np.array([[[0.7, 0.2, 0.1],
+                       [0.1, 0.6, 0.3]]], "float32")      # [1, 2, 3]
+    pre_ids = T([[1, 2]], "int64")
+    pre_sc = T([[-1.0, -2.0]], "float32")
+    ids, sc, par = ops.beam_search(pre_ids, pre_sc, T(probs),
+                                   beam_size=2, end_id=0,
+                                   is_accumulated=False)
+    total = np.array([[-1.0 + np.log(0.7), -1.0 + np.log(0.2),
+                       -1.0 + np.log(0.1)],
+                      [-2.0 + np.log(0.1), -2.0 + np.log(0.6),
+                       -2.0 + np.log(0.3)]], "float32").reshape(-1)
+    order = np.argsort(-total)
+    np.testing.assert_allclose(sc.numpy()[0], total[order[:2]], rtol=1e-5)
+    assert ids.numpy()[0].tolist() == [int(o % 3) for o in order[:2]]
+    assert par.numpy()[0].tolist() == [int(o // 3) for o in order[:2]]
+    # the old code ran log_softmax over the probabilities — i.e. treated
+    # them as LOGITS (log_softmax(0.7) != log(0.7)); the absolute-score
+    # assertion above fails under that treatment
+    np.testing.assert_allclose(sc.numpy()[0, 0],
+                               -1.0 + np.log(0.7), rtol=1e-5)

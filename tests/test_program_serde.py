@@ -187,3 +187,38 @@ def test_legacy_pickle_still_loads():
         loaded = jit.load(path)
         np.testing.assert_allclose(loaded(paddle.to_tensor(x)).numpy(),
                                    want, rtol=1e-5, atol=1e-6)
+
+
+class HashNet(nn.Layer):
+    def forward(self, x):
+        return ops.hash_bucket(x, num_hash=2, mod_by=97)
+
+
+def test_hash_bucket_v2_version_gate():
+    """ADVICE r05: hash_bucket v2 fixed the negative-bucket wraparound;
+    artifacts record the bumped version so a v1 framework refuses them
+    (and this build accepts old v1 artifacts, whose semantics it
+    supersedes compatibly for non-wrapping ids)."""
+    net = HashNet()
+    net.eval()
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "h")
+        jit.save(net, path, input_spec=[jit.InputSpec([2, 1], "int64", "x")])
+        doc = json.load(open(path + ".pdmodel"))
+        assert doc["op_versions"]["hash_bucket"] == 2
+        # an artifact from a FUTURE v3 framework is refused
+        for op in doc["ops"]:
+            if op["fn"].get("__opreg__") == "hash_bucket":
+                op["fn"]["version"] = 3
+        doc["op_versions"]["hash_bucket"] = 3
+        json.dump(doc, open(path + ".pdmodel", "w"))
+        with pytest.raises(OpVersionError, match="hash_bucket.*version 3"):
+            load_program(path)
+        # an OLD v1 artifact still loads (forward compatibility)
+        for op in doc["ops"]:
+            if op["fn"].get("__opreg__") == "hash_bucket":
+                op["fn"]["version"] = 1
+        doc["op_versions"]["hash_bucket"] = 1
+        json.dump(doc, open(path + ".pdmodel", "w"))
+        prog, feeds = load_program(path)
+        assert any(op.name == "hash_bucket" for op in prog.ops)

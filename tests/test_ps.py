@@ -317,3 +317,26 @@ def test_fleet_ps_end_to_end(tmp_path):
         for p in workers + [server]:
             if p.poll() is None:
                 p.kill()
+
+
+def test_sparse_table_pull_with_duplicate_ids():
+    """Regression (ADVICE r05): _ensure must dedupe unseen ids while
+    preserving order — pull([5, 9, 5]) once claimed two rows for id 5,
+    aliasing id 9's row and corrupting _index for every later id."""
+    from paddle_tpu.distributed.ps.table import SparseTable
+    t = SparseTable(4, optimizer="sgd", lr=0.1, init="uniform", seed=0)
+    rows = t.pull([5, 9, 5])
+    assert rows.shape == (3, 4)
+    assert len(t) == 2                      # two distinct ids materialized
+    np.testing.assert_array_equal(rows[0], rows[2])   # same id, same row
+    assert not np.array_equal(rows[0], rows[1])       # 9 got its OWN row
+    # indices are dense and order-preserving: 5 first-seen before 9
+    assert t._index[5] == 0 and t._index[9] == 1
+    # later ids keep extending densely
+    t.pull([7])
+    assert t._index[7] == 2
+    # pushes against duplicate-id pulls update exactly the two rows
+    before = t.pull([5, 9])
+    t.push_grad([5, 9, 5], np.ones((3, 4), "float32"))
+    after = t.pull([5, 9])
+    assert not np.allclose(before, after)

@@ -1,0 +1,223 @@
+"""Shape/dtype inference + memory estimator (ISSUE 1 tentpole).
+
+The rule library must agree with the record-time jax.eval_shape ground
+truth on representative programs (matmul/conv/reduce/concat/elementwise/
+control-flow), flag a deliberately mis-shaped matmul and an AMP
+fp16/fp32 boundary mismatch at build time, and feed a sane liveness
+peak-memory estimate for a small MLP."""
+import copy
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+from paddle_tpu.ops._dispatch import SHAPE_INFER_REGISTRY
+from paddle_tpu.static.program import _Ref
+from paddle_tpu.static.shape_infer import (ShapeInferError, analyze_memory,
+                                           infer_program)
+
+
+def _static():
+    import paddle_tpu.static as static
+    paddle.enable_static()
+    return static
+
+
+def test_rule_library_covers_at_least_25_ops():
+    assert len(SHAPE_INFER_REGISTRY) >= 25, sorted(SHAPE_INFER_REGISTRY)
+    for must in ("matmul", "conv2d", "concat", "sum", "mean", "add",
+                 "reshape", "transpose", "softmax", "embedding"):
+        assert must in SHAPE_INFER_REGISTRY
+
+
+def test_rules_agree_with_recorded_avals_on_representative_program():
+    """check=True cross-validates every rule against the record-time
+    eval_shape ground truth — any rule/kernel disagreement raises."""
+    static = _static()
+    try:
+        main = static.Program("rep")
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            w = static.data("w", [8, 3], "float32")
+            ids = static.data("ids", [4], "int64")
+            emb = static.data("emb", [16, 8], "float32")
+            h = ops.matmul(x, w)                       # [4, 3]
+            h = ops.add(h, ops.full([3], 1.0))         # broadcast
+            s = ops.softmax(h, axis=-1)
+            r = ops.sum(s, axis=1, keepdim=True)       # [4, 1]
+            m = ops.mean(h)                            # []
+            c = ops.concat([h, h], axis=1)             # [4, 6]
+            t = ops.transpose(c, [1, 0])               # [6, 4]
+            f = ops.reshape(t, [-1])                   # [24]
+            e = ops.embedding(emb, ids)                # [4, 8]
+            oh = ops.one_hot(ids, 5)                   # [4, 5]
+            cast = ops.cast(r, "int32")
+            img = static.data("img", [2, 3, 8, 8], "float32")
+            ker = static.data("ker", [4, 3, 3, 3], "float32")
+            conv = ops.conv2d(img, ker, stride=1, padding=1)  # [2,4,8,8]
+            relu = ops.relu(conv)
+        env = infer_program(main, check=True)
+        by = {v.var_id: v for op in main.ops for v in op.out_vars}
+        assert tuple(env[h.var_id].shape) == (4, 3)
+        assert tuple(env[c.var_id].shape) == (4, 6)
+        assert tuple(env[f.var_id].shape) == (24,)
+        assert tuple(env[e.var_id].shape) == (4, 8)
+        assert tuple(env[oh.var_id].shape) == (4, 5)
+        assert tuple(env[conv.var_id].shape) == (2, 4, 8, 8)
+        assert env[cast.var_id].dtype == np.dtype("int32")
+    finally:
+        paddle.disable_static()
+
+
+def test_control_flow_and_fallback_ops_infer_via_eval_shape():
+    static = _static()
+    try:
+        main = static.Program("cf")
+        with static.program_guard(main):
+            x = static.data("x", [4], "float32")
+            i = ops.zeros([], "int32")
+            n = ops.full([], 3, "int32")
+            _, acc = static.nn.while_loop(
+                lambda i, a: ops.less_than(i, n),
+                lambda i, a: (i + 1, a * 2.0), [i, x])
+            y = ops.roll(acc, 1)   # no explicit rule -> eval_shape path
+        env = infer_program(main, check=True)
+        assert tuple(env[acc.var_id].shape) == (4,)
+        assert tuple(env[y.var_id].shape) == (4,)
+    finally:
+        paddle.disable_static()
+
+
+def test_misshaped_matmul_flagged_at_build_time():
+    """A transpiler-style rewrite that rewires matmul's rhs to a
+    wrong-shaped var must fail inference with a named contraction
+    diagnostic — not an XLA trace error at Executor.run."""
+    static = _static()
+    try:
+        main = static.Program("bad_mm")
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            w = static.data("w", [8, 3], "float32")
+            out = ops.matmul(x, w)
+        broken = copy.copy(main)
+        mm = copy.copy(main.ops[0])
+        x_ref = mm.flat[0]
+        assert isinstance(x_ref, _Ref)
+        mm.flat = [x_ref, copy.copy(x_ref)] + list(mm.flat[2:])  # x @ x
+        broken.ops = [mm]
+        with pytest.raises(ShapeInferError, match="contraction") as e:
+            infer_program(broken)
+        assert e.value.op_name == "matmul"
+    finally:
+        paddle.disable_static()
+
+
+def test_recorded_aval_drift_detected():
+    static = _static()
+    try:
+        main = static.Program("drift")
+        with static.program_guard(main):
+            x = static.data("x", [2, 3], "float32")
+            y = ops.exp(x)
+        broken = copy.copy(main)
+        op = copy.copy(main.ops[0])
+        import jax
+        op.out_vars = [copy.copy(op.out_vars[0])]
+        op.out_vars[0].aval = jax.ShapeDtypeStruct((7, 7), jnp.float32)
+        broken.ops = [op]
+        with pytest.raises(ShapeInferError, match="records shape"):
+            infer_program(broken)
+    finally:
+        paddle.disable_static()
+
+
+def test_amp_boundary_mismatch_flagged():
+    """AMP O1/fp16: a gray-list op mixing fp16 and fp32 floats promotes
+    silently — infer_program reports it at build time."""
+    static = _static()
+    try:
+        main = static.Program("ampb")
+        with static.program_guard(main):
+            a = static.data("a", [4, 4], "float16")
+            b = static.data("b", [4, 4], "float32")
+            out = ops.add(a, b)   # gray zone: runs "in whatever arrives"
+        main.amp_level = "O1"
+        main.amp_dtype = jnp.float16
+        with pytest.raises(ShapeInferError, match="AMP boundary") as e:
+            infer_program(main)
+        assert "add" in str(e.value)
+        # amp_check=False: shapes still validate, boundary scan skipped
+        env = infer_program(main, amp_check=False)
+        assert tuple(env[out.var_id].shape) == (4, 4)
+    finally:
+        paddle.disable_static()
+
+
+def test_amp_white_op_casts_cleanly():
+    """White-list ops are cast wholesale by the executor's AMP policy —
+    the same cast simulated in inference, so no violation and fp16
+    output dtypes."""
+    static = _static()
+    try:
+        main = static.Program("ampw")
+        with static.program_guard(main):
+            x = static.data("x", [4, 8], "float32")
+            w = static.data("w", [8, 3], "float32")
+            out = ops.matmul(x, w)
+        main.amp_level = "O1"
+        main.amp_dtype = jnp.float16
+        env = infer_program(main)   # no boundary violation
+        assert env[out.var_id].dtype == np.dtype("float16")
+    finally:
+        paddle.disable_static()
+
+
+def test_memory_estimator_on_mlp():
+    static = _static()
+    try:
+        main = static.Program("mlp")
+        with static.program_guard(main):
+            x = static.data("x", [32, 64], "float32")
+            w1 = static.data("w1", [64, 128], "float32")
+            w2 = static.data("w2", [128, 10], "float32")
+            h = ops.relu(ops.matmul(x, w1))
+            out = ops.softmax(ops.matmul(h, w2))
+        main._jit_fetch_vars = [out]
+        est = analyze_memory(main)
+        feed = (32 * 64 + 64 * 128 + 128 * 10) * 4
+        assert est["feed_bytes"] == feed
+        assert est["param_bytes"] == 0
+        assert len(est["timeline"]) == len(main.ops)
+        # peak: feeds + the largest live activation set; h ([32,128]) and
+        # its matmul predecessor coexist, out is pinned to the end
+        assert est["activation_peak_bytes"] >= 32 * 128 * 4
+        assert est["peak_bytes"] <= feed + 4 * (
+            32 * 128 * 2 + 32 * 10 * 2)
+        assert est["peak_bytes"] == feed + est["activation_peak_bytes"]
+    finally:
+        paddle.disable_static()
+
+
+def test_executor_publishes_memory_estimate_under_flag():
+    from paddle_tpu.core import flags as flags_mod
+    from paddle_tpu.core import monitor
+    static = _static()
+    try:
+        main = static.Program("est")
+        with static.program_guard(main):
+            x = static.data("x", [4, 4], "float32")
+            out = ops.relu(x)
+        exe = static.Executor()
+        monitor.reset("executor/estimated_peak_bytes")
+        flags_mod.set_flags({"FLAGS_log_memory_estimate": True})
+        try:
+            got = exe.run(main, feed={"x": np.ones((4, 4), "float32")},
+                          fetch_list=[out])[0]
+        finally:
+            flags_mod.set_flags({"FLAGS_log_memory_estimate": False})
+        np.testing.assert_allclose(got, np.ones((4, 4)))
+        assert monitor.stat_get("executor/estimated_peak_bytes") > 0
+    finally:
+        paddle.disable_static()

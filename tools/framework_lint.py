@@ -1,0 +1,381 @@
+"""Repo-level framework lint (reference tools/check_op_desc.py +
+tools/check_api_compatible.py discipline, folded into one gate).
+
+Two families of checks, both pure-Python and fast enough for tier-1:
+
+1. Registry <-> surface cross-check: every `@defop`-registered op must be
+   visible in the committed API.spec (an op added without regenerating
+   the spec is invisible to API review), no spec entry may be MISSING
+   (dead surface), and each op's (signature, version) pair must match the
+   committed OP_VERSIONS.json snapshot — changing an op's signature
+   WITHOUT bumping `@defop(version=...)` is version drift: saved
+   .pdmodel artifacts would replay the op under new semantics with no
+   load-time warning (framework/program_serde.py op-version check).
+
+2. Tracer-concretization hazard scan: AST-walk every `@defop` body for
+   patterns that crash or silently specialize under jit/eval_shape
+   tracing — `if`/`while` on a tensor argument, `float()`/`int()`/
+   `bool()` of a tensor argument, and `.item()` anywhere. Tensor
+   arguments are approximated as positional parameters without defaults
+   (attrs carry defaults by convention). Deliberate host-side ops mark
+   the line with `# lint: concretization-ok`.
+
+Usage:
+  python tools/framework_lint.py            # check; exit 1 on violations
+  python tools/framework_lint.py --update   # rewrite OP_VERSIONS.json
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SPEC_PATH = os.path.join(REPO, "API.spec")
+VERSIONS_PATH = os.path.join(REPO, "OP_VERSIONS.json")
+OPS_DIR = os.path.join(REPO, "paddle_tpu", "ops")
+
+PRAGMA = "lint: concretization-ok"
+
+def _defop_modules():
+    """Every paddle_tpu module that registers ops — found by source scan,
+    so the lint's registry view does not depend on import order."""
+    pkg_root = os.path.join(REPO, "paddle_tpu")
+    mods = []
+    for root, _dirs, files in os.walk(pkg_root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path) as f:
+                if "defop" not in f.read():
+                    continue
+            rel = os.path.relpath(path, REPO)[:-3].replace(os.sep, ".")
+            if rel.endswith(".__init__"):
+                rel = rel[: -len(".__init__")]
+            mods.append(rel)
+    return sorted(mods)
+
+
+def _registry():
+    # import the complete op-defining surface first: op registration is
+    # an import side effect, and the lint must see the SAME registry no
+    # matter what the test process imported beforehand
+    import importlib
+    for mod in _defop_modules():
+        try:
+            importlib.import_module(mod)
+        except Exception:
+            pass  # optional deps (pallas on TPU etc.) may be absent
+    from paddle_tpu.ops import OP_REGISTRY
+    return OP_REGISTRY
+
+
+def _sig(fn):
+    try:
+        return str(inspect.signature(fn))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def _is_static_registration(fn):
+    """True for ops the version-snapshot discipline binds: defined at
+    module level of a repo module (registered by importing the library).
+    Runtime registrations — user custom ops (`register_custom_op`) and
+    kernels minted inside functions/classes (e.g. moe_layer) — are
+    process-local and cannot be snapshot-pinned."""
+    raw = getattr(fn, "raw", fn)
+    try:
+        path = inspect.getsourcefile(raw)
+        lines, _ = inspect.getsourcelines(raw)
+    except (TypeError, OSError):
+        return False
+    if not path or not os.path.abspath(path).startswith(
+            os.path.join(REPO, "paddle_tpu") + os.sep):
+        return False
+    first = next((ln for ln in lines if ln.strip()), "")
+    return not first.startswith((" ", "\t"))  # column-0 def/decorator
+
+
+# ---------------------------------------------------------------------------
+# check 1: registry vs API.spec vs OP_VERSIONS.json
+# ---------------------------------------------------------------------------
+
+def spec_leaf_names(spec_path=SPEC_PATH):
+    """Leaf names with at least one committed `def`/`class` entry."""
+    names = set()
+    missing = []
+    with open(spec_path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            head = line.split(" ", 1)[0]
+            leaf = head.rsplit(".", 1)[-1]
+            if " MISSING" in line:
+                missing.append(head)
+            else:
+                names.add(leaf)
+    return names, missing
+
+
+def _public_surface_leaves():
+    """Leaf names of the LIVE public surface (the same sweep
+    gen_api_spec commits to API.spec). Ops outside it are internal
+    kernels (serde-registered dispatch heads etc.) and owe the spec
+    nothing — but a publicly exported op missing from the committed spec
+    is an unreviewed surface change."""
+    import gen_api_spec
+    names = set()
+    for line in gen_api_spec.collect().splitlines():
+        head = line.split(" ", 1)[0]
+        names.add(head.rsplit(".", 1)[-1])
+    return names
+
+
+def check_registry_spec(spec_path=SPEC_PATH, versions_path=VERSIONS_PATH):
+    """Returns a list of violation strings (empty = clean)."""
+    reg = _registry()
+    problems = []
+    leaves, spec_missing = spec_leaf_names(spec_path)
+    for head in spec_missing:
+        problems.append(f"API.spec entry '{head}' is MISSING — dead "
+                        "surface; regenerate with tools/gen_api_spec.py")
+    public = _public_surface_leaves()
+    for name in sorted(reg):
+        if name in public and name not in leaves:
+            problems.append(
+                f"op '{name}' is in OP_REGISTRY but absent from API.spec "
+                "— regenerate the spec (tools/gen_api_spec.py --update) "
+                "or export the op")
+    try:
+        with open(versions_path) as f:
+            snapshot = json.load(f)
+    except FileNotFoundError:
+        return problems + [
+            f"{os.path.basename(versions_path)} not found — generate it "
+            "with `python tools/framework_lint.py --update`"]
+    for name, fn in sorted(reg.items()):
+        if not _is_static_registration(fn):
+            continue
+        live_v = int(getattr(fn, "op_version", 1))
+        live_sig = _sig(fn)
+        snap = snapshot.get(name)
+        if snap is None:
+            problems.append(
+                f"op '{name}' has no OP_VERSIONS.json entry — run "
+                "`python tools/framework_lint.py --update`")
+            continue
+        if live_v < int(snap["version"]):
+            problems.append(
+                f"op '{name}' version regressed: snapshot v{snap['version']}"
+                f" but @defop declares v{live_v}")
+        elif live_v > int(snap["version"]):
+            # a stale snapshot would disarm the drift check for every
+            # future signature change to this op
+            problems.append(
+                f"op '{name}' was bumped to v{live_v} but OP_VERSIONS.json "
+                f"still records v{snap['version']} — run "
+                "`python tools/framework_lint.py --update` to re-pin it")
+        elif live_sig != snap["sig"]:
+            problems.append(
+                f"op '{name}' signature drifted ({snap['sig']} -> "
+                f"{live_sig}) without a version bump — bump "
+                f"@defop(version={live_v + 1}) so program_serde flags old "
+                "artifacts, then --update the snapshot")
+    for name in sorted(set(snapshot) - set(reg)):
+        problems.append(
+            f"OP_VERSIONS.json lists op '{name}' which is no longer "
+            "registered — removed ops break saved artifacts; run --update "
+            "if the removal is deliberate")
+    return problems
+
+
+def update_versions(versions_path=VERSIONS_PATH):
+    reg = _registry()
+    snap = {name: {"version": int(getattr(fn, "op_version", 1)),
+                   "sig": _sig(fn)}
+            for name, fn in sorted(reg.items())
+            if _is_static_registration(fn)}
+    with open(versions_path, "w") as f:
+        json.dump(snap, f, indent=0, sort_keys=True)
+        f.write("\n")
+    return len(snap)
+
+
+# ---------------------------------------------------------------------------
+# check 2: tracer-concretization hazards in @defop bodies
+# ---------------------------------------------------------------------------
+
+def _is_defop_decorator(dec):
+    if isinstance(dec, ast.Name) and dec.id == "defop":
+        return True
+    if isinstance(dec, ast.Call):
+        return _is_defop_decorator(dec.func)
+    if isinstance(dec, ast.Attribute) and dec.attr == "defop":
+        return True
+    return False
+
+
+_ARRAY_ROOTS = {"jnp", "jax", "lax"}
+
+
+def _call_root(func):
+    while isinstance(func, ast.Attribute):
+        func = func.value
+    return func.id if isinstance(func, ast.Name) else None
+
+
+def _tensor_params(fdef: ast.FunctionDef):
+    """Parameters that flow into jnp/jax/lax as the FIRST positional
+    bare-name argument of a call — the dataflow approximation of 'this
+    is the traced array', robust against int-like attrs (`axis`,
+    `num_classes`) that a signature-position heuristic misclassifies."""
+    params = {a.arg for a in fdef.args.posonlyargs + fdef.args.args}
+    tensors = set()
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Call) and node.args \
+                and _call_root(node.func) in _ARRAY_ROOTS \
+                and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in params:
+            tensors.add(node.args[0].id)
+    return tensors
+
+
+_STATIC_CALLS = {"isinstance", "len", "getattr", "hasattr", "type"}
+
+
+def _value_names(node, out=None):
+    """Names used in VALUE position: excludes attribute access
+    (`x.dtype`, `x.shape[i]` — static metadata), `is`/`is not`
+    comparisons, and isinstance/len/… introspection calls, all of which
+    are legitimate at trace time."""
+    if out is None:
+        out = set()
+    if isinstance(node, ast.Name):
+        out.add(node.id)
+        return out
+    if isinstance(node, ast.Attribute):
+        return out  # x.anything — metadata/method access, not the value
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id in _STATIC_CALLS:
+            return out
+        for a in node.args:
+            _value_names(a, out)
+        for k in node.keywords:
+            _value_names(k.value, out)
+        return out
+    if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        return out  # `x is None` — identity test, never concretizes
+    for child in ast.iter_child_nodes(node):
+        _value_names(child, out)
+    return out
+
+
+class _HazardVisitor(ast.NodeVisitor):
+    def __init__(self, path, src_lines, fdef):
+        self.path = path
+        self.lines = src_lines
+        self.fdef = fdef
+        self.tensors = _tensor_params(fdef)
+        self.hits = []
+
+    def _pragma(self, node):
+        line = self.lines[node.lineno - 1] if node.lineno - 1 < len(
+            self.lines) else ""
+        return PRAGMA in line
+
+    def _hit(self, node, what):
+        if not self._pragma(node):
+            self.hits.append(
+                f"{os.path.relpath(self.path, REPO)}:{node.lineno} "
+                f"[{self.fdef.name}] {what}")
+
+    def visit_If(self, node):
+        bad = _value_names(node.test) & self.tensors
+        if bad:
+            self._hit(node, "`if` on traced tensor argument "
+                            f"({', '.join(sorted(bad))}) — the branch is "
+                            "baked at trace time; use jnp.where/lax.cond")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        bad = _value_names(node.test) & self.tensors
+        if bad:
+            self._hit(node, "`while` on traced tensor argument "
+                            f"({', '.join(sorted(bad))}) — use "
+                            "lax.while_loop")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Name) \
+                and node.func.id in ("float", "int", "bool") and node.args:
+            bad = _value_names(node.args[0]) & self.tensors
+            if bad:
+                self._hit(node, f"`{node.func.id}()` concretizes traced "
+                                f"tensor argument ({', '.join(sorted(bad))})")
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+            self._hit(node, "`.item()` concretizes a traced value")
+        self.generic_visit(node)
+
+
+def check_concretization(ops_dir=OPS_DIR):
+    """AST-scan @defop bodies; returns a list of violation strings."""
+    hits = []
+    for root, _dirs, files in os.walk(ops_dir):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path) as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src)
+            except SyntaxError as e:
+                hits.append(f"{path}: unparseable ({e})")
+                continue
+            src_lines = src.splitlines()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.FunctionDef) and any(
+                        _is_defop_decorator(d) for d in node.decorator_list):
+                    v = _HazardVisitor(path, src_lines, node)
+                    for stmt in node.body:
+                        v.visit(stmt)
+                    hits.extend(v.hits)
+    return hits
+
+
+# ---------------------------------------------------------------------------
+
+def run_lint(spec_path=SPEC_PATH, versions_path=VERSIONS_PATH,
+             ops_dir=OPS_DIR):
+    problems = check_registry_spec(spec_path, versions_path)
+    problems += check_concretization(ops_dir)
+    return problems
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--update" in argv:
+        n = update_versions()
+        print(f"wrote {VERSIONS_PATH} ({n} ops)")
+        return 0
+    problems = run_lint()
+    if problems:
+        print(f"framework_lint: {len(problems)} violation(s)")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print("framework_lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
