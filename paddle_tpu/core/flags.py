@@ -98,3 +98,36 @@ define_flag("FLAGS_use_fused_ce", True,
 define_flag("FLAGS_pallas_interpret", False,
             "run all Pallas kernels off-TPU via the interpreter (slow; "
             "for tests)")
+
+# --- PS transport fault tolerance (distributed/ps/rpc.py) ---------------
+# The reference's brpc channel exposes the same three knobs
+# (connect_timeout_ms / timeout_ms / max_retry in brpc_ps_client.cc);
+# flag names double as their env-var spelling, so a job script can export
+# PADDLE_PS_CALL_TIMEOUT=5 without touching code.
+define_flag("PADDLE_PS_CALL_TIMEOUT", 60.0,
+            "per-RPC deadline in seconds; a call that stalls past it "
+            "times out, retries, and finally raises DeadlineExceeded")
+define_flag("PADDLE_PS_MAX_RETRIES", 5,
+            "transport retry budget per call (attempts = retries + 1); "
+            "mutating calls are made retry-safe by the server-side "
+            "idempotent replay cache")
+define_flag("PADDLE_PS_BACKOFF_BASE_S", 0.05,
+            "first retry backoff in seconds; doubles per retry with "
+            "jitter up to PADDLE_PS_BACKOFF_MAX_S")
+define_flag("PADDLE_PS_BACKOFF_MAX_S", 2.0,
+            "exponential backoff ceiling in seconds")
+define_flag("PADDLE_PS_CONNECT_RETRY_S", 30.0,
+            "initial-dial retry window: workers racing the server's bind "
+            "at job start keep redialing this long before giving up")
+define_flag("PADDLE_PS_MAX_FRAME", 1 << 30,
+            "largest RPC frame either side will accept; a length prefix "
+            "over this is rejected as a FrameError instead of an "
+            "unbounded allocation from one garbled header")
+define_flag("PADDLE_PS_REPLAY_CACHE", 512,
+            "per-client entries in the server's idempotent-replay LRU; "
+            "a retried mutating request inside this window replays the "
+            "cached reply instead of re-applying the gradient")
+define_flag("PADDLE_PS_SEND_RETRIES", 2,
+            "extra Communicator send-thread attempts (with backoff) on "
+            "top of the per-call transport retries before the thread "
+            "declares itself dead")

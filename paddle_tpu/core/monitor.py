@@ -35,9 +35,13 @@ def stat_get(name: str):
         return _stats.get(name, 0)
 
 
-def stats() -> dict:
+def stats(prefix: str = None) -> dict:
+    """Snapshot all counters; `prefix` filters to one subsystem (e.g.
+    stats("ps.rpc.") for the PS transport health counters)."""
     with _lock:
-        return dict(_stats)
+        if prefix is None:
+            return dict(_stats)
+        return {k: v for k, v in _stats.items() if k.startswith(prefix)}
 
 
 def reset(name: str = None):

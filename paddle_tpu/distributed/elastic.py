@@ -63,16 +63,39 @@ class Heartbeat:
 
     @staticmethod
     def check(directory, timeout_s=60.0):
-        """Supervisor side: ranks whose heartbeat is stale (dead/hung)."""
+        """Supervisor side: ranks whose heartbeat is stale (dead/hung).
+
+        Never raises on bad beat files: the supervisor is the one process
+        that must outlive everything else, and a trainer dying mid-write
+        (or a vanished file, or a corrupted disk) is exactly the moment
+        it's needed. A heartbeat that can't be read or parsed counts as
+        STALE — liveness must be proven, not assumed."""
         now = time.time()
         stale = []
-        for name in sorted(os.listdir(directory)):
-            if not name.startswith("heartbeat_"):
+        try:
+            names = sorted(os.listdir(directory))
+        except OSError:
+            return []   # directory gone: nothing provably alive OR dead
+        for name in names:
+            # only committed beat files; skips the atomic-write .tmp twin
+            if not (name.startswith("heartbeat_")
+                    and name.endswith(".json")):
                 continue
-            with open(os.path.join(directory, name)) as f:
-                rec = json.load(f)
-            if now - rec["time"] > timeout_s:
-                stale.append(rec["rank"])
+            try:
+                rank = int(name[len("heartbeat_"):-len(".json")])
+            except ValueError:
+                continue
+            try:
+                with open(os.path.join(directory, name)) as f:
+                    rec = json.load(f)
+                beat_time = float(rec["time"])
+                rank = int(rec.get("rank", rank))
+            except (OSError, ValueError, KeyError, TypeError):
+                # corrupt / partial / vanished mid-check → stale rank
+                stale.append(rank)
+                continue
+            if now - beat_time > timeout_s:
+                stale.append(rank)
         return stale
 
 

@@ -23,13 +23,15 @@ import numpy as np
 
 from .client import Communicator, PSClient
 from .heter import DeviceHashTable, HeterPSCache
+from .rpc import AuthError, DeadlineExceeded, FrameError
 from .server import PSServer
 from .table import (BarrierTable, DenseTable, GeoSparseTable, SparseTable,
                     make_table)
 
 __all__ = ["PSServer", "PSClient", "Communicator", "DenseTable",
            "SparseTable", "GeoSparseTable", "BarrierTable", "make_table",
-           "SparseEmbedding", "DeviceHashTable", "HeterPSCache"]
+           "SparseEmbedding", "DeviceHashTable", "HeterPSCache",
+           "DeadlineExceeded", "FrameError", "AuthError"]
 
 
 class SparseEmbedding:

@@ -17,21 +17,34 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import uuid
 import zlib
 
 import numpy as np
 
+from ...core import monitor as _monitor
+from ...core.flags import flag as _flag
 from .rpc import Connection
 
 __all__ = ["PSClient", "Communicator"]
 
 
 class PSClient:
-    def __init__(self, server_endpoints):
+    """Every fan-out routes through the retrying `rpc.Connection`, and
+    mutating calls (push_*/set_dense/barrier) are stamped for idempotent
+    replay — a retried push after a lost response applies exactly once.
+    `**rpc_opts` (timeout, max_retries, backoff_base, ...) override the
+    PADDLE_PS_* flag defaults per client."""
+
+    # Communicator probes this before threading request_keys through
+    # push_* (test doubles with bare push signatures stay valid)
+    supports_request_keys = True
+
+    def __init__(self, server_endpoints, **rpc_opts):
         if isinstance(server_endpoints, str):
             server_endpoints = server_endpoints.split(",")
         self.endpoints = list(server_endpoints)
-        self._conns = [Connection(ep) for ep in self.endpoints]
+        self._conns = [Connection(ep, **rpc_opts) for ep in self.endpoints]
 
     @property
     def n_servers(self):
@@ -42,16 +55,26 @@ class PSClient:
         # worker must route a dense table to the same server
         return self._conns[zlib.crc32(table.encode()) % self.n_servers]
 
+    @staticmethod
+    def _rkey(request_key, method, table):
+        # outer-retry-stable replay key: one merged batch can push several
+        # tables (and both dense+sparse of the same name) to one server,
+        # so the method and table disambiguate within the batch key
+        return None if request_key is None else (request_key, method, table)
+
     # --------------------------------------------------------------- dense
     def pull_dense(self, table):
         return self._dense_conn(table).call("pull_dense", table=table)
 
-    def push_dense_grad(self, table, grad):
-        self._dense_conn(table).call("push_dense_grad", table=table,
-                                     grad=np.asarray(grad, np.float32))
+    def push_dense_grad(self, table, grad, request_key=None):
+        self._dense_conn(table).call(
+            "push_dense_grad", _mutating=True,
+            _key=self._rkey(request_key, "pdg", table),
+            table=table, grad=np.asarray(grad, np.float32))
 
     def set_dense(self, table, value):
-        self._dense_conn(table).call("set_dense", table=table,
+        self._dense_conn(table).call("set_dense", _mutating=True,
+                                     table=table,
                                      value=np.asarray(value, np.float32))
 
     # -------------------------------------------------------------- sparse
@@ -78,33 +101,55 @@ class PSClient:
             raise ValueError("pull_sparse with zero ids")
         return out
 
-    def push_sparse_grad(self, table, ids, grads):
+    def push_sparse_grad(self, table, ids, grads, request_key=None):
         ids, owner = self._shard(ids)
         grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
         for s in range(self.n_servers):
             mask = owner == s
             if mask.any():
-                self._conns[s].call("push_sparse_grad", table=table,
-                                    ids=ids[mask], grads=grads[mask])
+                self._conns[s].call(
+                    "push_sparse_grad", _mutating=True,
+                    _key=self._rkey(request_key, "psg", table),
+                    table=table, ids=ids[mask], grads=grads[mask])
 
-    def push_sparse_delta(self, table, ids, deltas):
+    def push_sparse_delta(self, table, ids, deltas, request_key=None):
         ids, owner = self._shard(ids)
         deltas = np.asarray(deltas, np.float32).reshape(len(ids), -1)
         for s in range(self.n_servers):
             mask = owner == s
             if mask.any():
-                self._conns[s].call("push_sparse_delta", table=table,
-                                    ids=ids[mask], deltas=deltas[mask])
+                self._conns[s].call(
+                    "push_sparse_delta", _mutating=True,
+                    _key=self._rkey(request_key, "psd", table),
+                    table=table, ids=ids[mask], deltas=deltas[mask])
 
     # --------------------------------------------------------------- misc
     def barrier(self, table, trainer_id, timeout=120.0):
         # barrier table lives on server 0 (reference BarrierTable is
-        # likewise singular)
-        return self._conns[0].call("barrier", table=table,
-                                   trainer_id=trainer_id, timeout=timeout)
+        # likewise singular); the RPC deadline must outlast the barrier's
+        # own server-side wait or every long barrier would look stalled
+        return self._conns[0].call("barrier", _mutating=True,
+                                   _timeout=float(timeout) + 30.0,
+                                   table=table, trainer_id=trainer_id,
+                                   timeout=timeout)
+
+    def ping(self):
+        """Probe every server's transport (pre-auth health method);
+        returns one latency in seconds per server."""
+        out = []
+        for c in self._conns:
+            t0 = time.perf_counter()
+            c.ping()
+            out.append(time.perf_counter() - t0)
+        return out
 
     def table_state(self, table, server=0):
         return self._conns[server].call("table_state", table=table)
+
+    def table_applied(self, table, server=0):
+        """How many mutating pushes a server's table has APPLIED (replayed
+        retries don't count) — the observable for exactly-once tests."""
+        return self._conns[server].call("table_applied", table=table)
 
     def save_snapshot(self, path):
         """Ask every server to snapshot its tables to server-local disk
@@ -144,6 +189,15 @@ class Communicator:
         self._q: queue.Queue = queue.Queue(maxsize=max_queue)
         self._stop = threading.Event()
         self._error: BaseException | None = None
+        # per-merged-batch replay key: outer send retries reuse it, so a
+        # batch that half-landed (server 0 applied, server 1 reset) is
+        # finished rather than double-applied on the servers that took
+        # it. Namespaced by a per-Communicator id — batch numbers restart
+        # at 1 in every instance, and two communicators over one client
+        # must not collide in the server's replay cache
+        self._comm_id = uuid.uuid4().hex[:16]
+        self._batch_no = 0
+        self._keyed = bool(getattr(client, "supports_request_keys", False))
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -199,7 +253,7 @@ class Communicator:
                 if pending and (len(pending) >= self._send_every
                                 or self._stop.is_set() or aged):
                     try:
-                        self._send_merged(pending)
+                        self._send_with_retry(pending)
                     finally:
                         for _ in pending:
                             self._q.task_done()
@@ -218,7 +272,31 @@ class Communicator:
                 except queue.Empty:
                     break
 
-    def _send_merged(self, items):
+    def _send_with_retry(self, items):
+        """One more layer of patience on top of the per-call transport
+        retries: back off and re-send the merged batch (under its stable
+        replay key — exactly-once holds across these retries too) before
+        declaring the send thread dead."""
+        self._batch_no += 1
+        key = (self._comm_id, self._batch_no) if self._keyed else None
+        attempts = int(_flag("PADDLE_PS_SEND_RETRIES")) + 1
+        backoff = float(_flag("PADDLE_PS_BACKOFF_BASE_S"))
+        ceiling = float(_flag("PADDLE_PS_BACKOFF_MAX_S"))
+        for attempt in range(attempts):
+            try:
+                self._send_merged(items, key)
+                return
+            except OSError:
+                # ConnectionError / DeadlineExceeded / FrameError — the
+                # transport already burned its own retry budget
+                if attempt == attempts - 1:
+                    raise
+                _monitor.stat_add("ps.communicator.send_retries")
+                # 4x the transport's base so the outer layer backs off
+                # slower than the inner one, same configurable ceiling
+                time.sleep(min(ceiling, backoff * (2 ** attempt) * 4))
+
+    def _send_merged(self, items, request_key=None):
         sparse: dict[str, list] = {}
         dense: dict[str, np.ndarray] = {}
         for kind, table, ids, grads in items:
@@ -229,6 +307,7 @@ class Communicator:
                     dense[table] = dense[table] + grads
                 else:
                     dense[table] = grads
+        kw = {"request_key": request_key} if self._keyed else {}
         for table, parts in sparse.items():
             ids = np.concatenate([p[0] for p in parts])
             grads = np.concatenate(
@@ -237,9 +316,9 @@ class Communicator:
             uniq, inv = np.unique(ids, return_inverse=True)
             merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
             np.add.at(merged, inv, grads)
-            self._client.push_sparse_grad(table, uniq, merged)
+            self._client.push_sparse_grad(table, uniq, merged, **kw)
         for table, grad in dense.items():
-            self._client.push_dense_grad(table, grad)
+            self._client.push_dense_grad(table, grad, **kw)
 
     def flush(self, timeout=60.0):
         deadline = time.monotonic() + timeout

@@ -125,6 +125,7 @@ class HeterPSCache:
         mapping like SparseEmbedding.pull. Misses fetch from the host PS
         and populate the device table."""
         import jax.numpy as jnp
+        from ...core import monitor
         ids_np = np.asarray(ids, np.int64).reshape(-1)
         uniq, inv = np.unique(ids_np, return_inverse=True)
         rows, found = self.dev.lookup(uniq)
@@ -132,6 +133,10 @@ class HeterPSCache:
         miss = uniq[~found_np]
         self.hits += int(found_np.sum())
         self.misses += len(miss)
+        # cache efficiency next to the transport's ps.rpc.* flakiness
+        # counters: a miss storm after a PS reconnect shows up here
+        monitor.stat_add("ps.heter.hits", int(found_np.sum()))
+        monitor.stat_add("ps.heter.misses", len(miss))
         if len(miss):
             fetched = np.asarray(self.client.pull_sparse(self.table, miss),
                                  np.float32)

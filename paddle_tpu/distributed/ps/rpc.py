@@ -9,15 +9,33 @@ ride as raw buffers (zero-copy out of the socket), metadata as a small
 pickled header. One thread per live connection on the server; clients
 hold one persistent connection per server and serialize calls on it.
 
+Fault tolerance (the reference's brpc channel carries connect_timeout +
+timeout_ms + max_retry; HeartBeatMonitor assumes peers churn): every call
+runs under a per-call deadline, transient transport failures (RST, EOF,
+timeout, garbled frame) tear the socket down, back off exponentially with
+jitter, transparently re-dial (re-running the auth handshake) and resend,
+up to a retry budget — after which DeadlineExceeded / ConnectionError
+propagates naming the method and endpoint. Retrying a MUTATING call is
+made safe by idempotent replay: the client stamps such requests with a
+(client_id, seq) request id and the server keeps a bounded per-client LRU
+of recently applied ids, replaying the cached reply instead of
+re-applying — a retry after a lost *response* cannot double-count a
+gradient. Frame lengths are bounded by PADDLE_PS_MAX_FRAME on both ends
+so one garbled header cannot OOM a peer. Flakiness is visible before it
+becomes an outage through core.monitor counters: ps.rpc.retries,
+ps.rpc.reconnects, ps.rpc.deadline_exceeded, ps.rpc.replays,
+ps.rpc.bad_frames.
+
 Security: deserialization uses a RESTRICTED unpickler that only resolves
 numpy array/dtype reconstructors and plain containers — an arbitrary
 `__reduce__` gadget from a hostile peer raises UnpicklingError instead of
 executing (the reference's protobuf transport has no gadget surface; this
 restores that property). Defense in depth: set PADDLE_PS_TOKEN in the job
 environment and every connection must open with a matching token
-handshake before any request is served. PS endpoints are still cluster
-infrastructure — bind them to loopback or a trusted network, never the
-open internet.
+handshake before any request is served (`__ping__` alone is answered
+pre-auth so supervisors can health-check without the token). PS endpoints
+are still cluster infrastructure — bind them to loopback or a trusted
+network, never the open internet.
 """
 from __future__ import annotations
 
@@ -26,15 +44,64 @@ import importlib
 import io
 import os
 import pickle
+import random
 import socket
 import struct
 import threading
+import time
+import uuid
+from collections import OrderedDict
 
-import numpy as np
+from ...core import monitor as _monitor
+from ...core.flags import flag as _flag
 
-__all__ = ["send_msg", "recv_msg", "Connection", "serve"]
+__all__ = ["send_msg", "recv_msg", "Connection", "serve", "FrameError",
+           "AuthError", "DeadlineExceeded", "ReplayCache",
+           "set_fault_injector"]
 
 _HDR = struct.Struct("!Q")
+
+
+class FrameError(ConnectionError):
+    """Oversized or garbled frame — the stream is unusable past it, so
+    the connection is dropped (ConnectionError subclass: generic
+    transport-failure handlers treat it as such)."""
+
+
+class AuthError(ConnectionError):
+    """Token handshake rejected. ConnectionError subclass for callers'
+    sake, but never retried — a bad token stays bad."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """A call stalled past PADDLE_PS_CALL_TIMEOUT on every attempt of its
+    retry budget. TimeoutError subclass (and therefore OSError), so
+    existing `except (ConnectionError, OSError)` cleanup paths catch it.
+    """
+
+
+# --- fault-injection seam (paddle_tpu.testing.faults) --------------------
+# A test-only hook consulted at frame boundaries. None in production; the
+# branch is one global load per event, negligible next to a socket op.
+_fault_injector = None
+
+
+def set_fault_injector(injector):
+    """Install (or clear, with None) the process-global fault injector.
+    Use paddle_tpu.testing.faults.inject(...) rather than calling this
+    directly."""
+    global _fault_injector
+    _fault_injector = injector
+
+
+def _fault(side, event, method):
+    inj = _fault_injector
+    if inj is None:
+        return None
+    return inj.on_event(side, event, method)
+
+
+# --- restricted deserialization ------------------------------------------
 
 # modules:names the restricted unpickler will resolve — numpy array/dtype
 # reconstruction plus the stdlib pieces numpy's reducers reference
@@ -97,20 +164,39 @@ def _unpack(data: bytes):
     return _loads(parts[0], buffers=parts[1:])
 
 
-def send_msg(sock: socket.socket, obj) -> None:
+def send_msg(sock: socket.socket, obj, max_frame=None) -> None:
     data = _pack(obj)
+    limit = _flag("PADDLE_PS_MAX_FRAME") if max_frame is None else max_frame
+    if len(data) > limit:
+        raise FrameError(
+            f"ps rpc: refusing to send a {len(data)}-byte frame "
+            f"(PADDLE_PS_MAX_FRAME={limit})")
     sock.sendall(_HDR.pack(len(data)) + data)
 
 
-def recv_msg(sock: socket.socket):
+def recv_msg(sock: socket.socket, max_frame=None):
+    """One framed message, None on clean EOF. Raises FrameError on a
+    length prefix over PADDLE_PS_MAX_FRAME (no allocation happens) or a
+    payload the restricted unpickler rejects — after either, the stream
+    is desynced and the connection must be dropped."""
     head = _recv_exact(sock, _HDR.size)
     if head is None:
         return None
     (n,) = _HDR.unpack(head)
+    limit = _flag("PADDLE_PS_MAX_FRAME") if max_frame is None else max_frame
+    if n > limit:
+        raise FrameError(
+            f"ps rpc: peer announced a {n}-byte frame "
+            f"(PADDLE_PS_MAX_FRAME={limit}) — dropping connection")
     data = _recv_exact(sock, n)
     if data is None:
         return None
-    return _unpack(data)
+    try:
+        return _unpack(data)
+    except pickle.UnpicklingError:
+        raise
+    except (struct.error, ValueError, EOFError, IndexError, KeyError) as e:
+        raise FrameError(f"ps rpc: garbled frame: {e}") from e
 
 
 def _recv_exact(sock, n):
@@ -125,58 +211,260 @@ def _recv_exact(sock, n):
     return buf.getvalue()
 
 
-class Connection:
-    """Client side: one persistent socket, calls serialized by a lock.
-    Connect retries briefly — workers routinely race the server's bind at
-    job start (the reference's brpc channel does the same via
-    connect_timeout + retry policy)."""
+# --- client side ----------------------------------------------------------
 
-    def __init__(self, endpoint: str, timeout=120.0, connect_retry_s=30.0):
-        import time
-        host, port = endpoint.rsplit(":", 1)
+class Connection:
+    """Client side: one persistent socket, calls serialized by a lock,
+    transparent retry/reconnect under a per-call deadline.
+
+    `timeout` is the per-attempt deadline (socket-level, covers send and
+    recv); `max_retries` extra attempts follow a failed one after an
+    exponentially growing jittered backoff. Reconnects re-run the
+    PADDLE_PS_TOKEN auth handshake. Mutating calls pass _mutating=True so
+    a resend carries the same (client_id, seq) request id and the server
+    can replay instead of re-applying (see serve/ReplayCache)."""
+
+    def __init__(self, endpoint: str, timeout=None, connect_retry_s=None,
+                 max_retries=None, backoff_base=None, backoff_max=None):
+        self.endpoint = endpoint
+        self._timeout = float(_flag("PADDLE_PS_CALL_TIMEOUT")
+                              if timeout is None else timeout)
+        self._max_retries = int(_flag("PADDLE_PS_MAX_RETRIES")
+                                if max_retries is None else max_retries)
+        self._backoff_base = float(_flag("PADDLE_PS_BACKOFF_BASE_S")
+                                   if backoff_base is None else backoff_base)
+        self._backoff_max = float(_flag("PADDLE_PS_BACKOFF_MAX_S")
+                                  if backoff_max is None else backoff_max)
+        connect_retry_s = float(_flag("PADDLE_PS_CONNECT_RETRY_S")
+                                if connect_retry_s is None
+                                else connect_retry_s)
+        self._lock = threading.Lock()
+        self._sock = None
+        # request-id namespace for idempotent replay: unique per client
+        # connection object, stable across reconnects
+        self._client_id = uuid.uuid4().hex
+        self._seq = 0
+        self._dial(connect_retry_s)
+
+    # ---------------------------------------------------------- transport
+    def _dial(self, connect_retry_s):
+        """Connect + auth handshake. Only the TCP connect is retried
+        within the window (workers routinely race the server's bind at
+        job start — the reference's brpc channel does the same via
+        connect_timeout + retry policy); an auth REJECTION is final."""
+        host, port = self.endpoint.rsplit(":", 1)
         deadline = time.monotonic() + connect_retry_s
         while True:
             try:
-                self._sock = socket.create_connection(
-                    (host, int(port)), timeout=timeout)
+                sock = socket.create_connection(
+                    (host, int(port)), timeout=self._timeout)
                 break
             except OSError:
                 if time.monotonic() >= deadline:
                     raise
                 time.sleep(0.2)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._lock = threading.Lock()
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(self._timeout)
         token = os.environ.get("PADDLE_PS_TOKEN")
         if token:
-            send_msg(self._sock, {"method": "__auth__", "token": token})
-            reply = recv_msg(self._sock)
+            try:
+                send_msg(sock, {"method": "__auth__", "token": token})
+                reply = recv_msg(sock)
+            except OSError:
+                sock.close()
+                raise
             if not reply or reply.get("error"):
-                raise ConnectionError(
+                sock.close()
+                raise AuthError(
                     "ps auth handshake rejected: "
                     f"{(reply or {}).get('error', 'closed')}")
+        self._sock = sock
 
-    def call(self, method: str, **kwargs):
+    def _teardown(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    # --------------------------------------------------------------- calls
+    def call(self, method: str, _mutating=False, _key=None, _timeout=None,
+             **kwargs):
+        """One RPC under the retry/deadline policy. `_mutating` stamps a
+        replay id; `_key` (optional, any hashable) pins that id so an
+        OUTER retry loop (e.g. the Communicator's send thread) stays
+        exactly-once too; `_timeout` overrides the per-attempt deadline
+        (barriers legitimately block longer than data calls)."""
+        timeout = self._timeout if _timeout is None else float(_timeout)
+        req = {"method": method, **kwargs}
         with self._lock:
-            send_msg(self._sock, {"method": method, **kwargs})
-            reply = recv_msg(self._sock)
-        if reply is None:
-            raise ConnectionError(f"server closed during {method!r}")
-        if reply.get("error"):
-            raise RuntimeError(f"ps server error in {method!r}: "
-                               f"{reply['error']}")
-        return reply.get("result")
+            if _mutating:
+                if _key is None:
+                    self._seq += 1
+                    _key = self._seq
+                req["__rid__"] = (self._client_id, _key)
+            # pack ONCE, outside the retry loop: an oversized request is
+            # a deterministic local error (no retry, nothing hit the
+            # wire), and resends reuse the bytes instead of re-pickling
+            payload = _pack(req)
+            limit = _flag("PADDLE_PS_MAX_FRAME")
+            if len(payload) > limit:
+                raise FrameError(
+                    f"ps rpc: request for {method!r} on {self.endpoint} "
+                    f"is {len(payload)} bytes "
+                    f"(PADDLE_PS_MAX_FRAME={limit})")
+            frame = _HDR.pack(len(payload)) + payload
+            attempts = self._max_retries + 1
+            last_err = None
+            for attempt in range(attempts):
+                if attempt:
+                    _monitor.stat_add("ps.rpc.retries")
+                    delay = min(self._backoff_max,
+                                self._backoff_base * (2 ** (attempt - 1)))
+                    # full jitter on [delay/2, delay] — decorrelates
+                    # thundering-herd retries across workers
+                    time.sleep(delay * (0.5 + random.random() / 2))
+                try:
+                    if self._sock is None:
+                        self._dial(timeout)
+                        _monitor.stat_add("ps.rpc.reconnects")
+                    self._sock.settimeout(timeout)
+                    _fault("client", "send", method)
+                    self._sock.sendall(frame)
+                    _fault("client", "recv", method)
+                    reply = recv_msg(self._sock)
+                    if reply is None:
+                        raise ConnectionError("peer closed connection")
+                except AuthError:
+                    self._teardown()
+                    raise          # auth rejection is never transient
+                except (OSError, pickle.UnpicklingError) as e:
+                    # covers ConnectionError, FrameError, socket timeout
+                    last_err = e
+                    self._teardown()
+                    continue
+                if reply.get("error"):
+                    raise RuntimeError(f"ps server error in {method!r}: "
+                                       f"{reply['error']}")
+                return reply.get("result")
+        if isinstance(last_err, TimeoutError):
+            _monitor.stat_add("ps.rpc.deadline_exceeded")
+            raise DeadlineExceeded(
+                f"ps rpc deadline exceeded calling {method!r} on "
+                f"{self.endpoint}: {attempts} attempts of {timeout:.1f}s "
+                "each (PADDLE_PS_CALL_TIMEOUT / PADDLE_PS_MAX_RETRIES)"
+            ) from last_err
+        raise ConnectionError(
+            f"ps rpc failed calling {method!r} on {self.endpoint} after "
+            f"{attempts} attempts: {last_err}") from last_err
+
+    def ping(self, timeout=None):
+        """Transport liveness probe; served by the peer before auth, so
+        it works for supervisors that don't hold the job token."""
+        return self.call("__ping__", _timeout=timeout)
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._teardown()
+
+
+# --- server side ----------------------------------------------------------
+
+class ReplayCache:
+    """Bounded per-client LRU of recently applied mutating requests
+    (rid -> reply), the correctness keystone that makes retry safe: a
+    retry after a lost response replays the cached reply instead of
+    re-applying the gradient. Entries in flight (handler still running
+    when the retry lands on a fresh connection) park the retry on an
+    Event rather than double-executing."""
+
+    _PENDING, _DONE = 0, 1
+
+    def __init__(self, per_client=None, max_clients=1024):
+        self._per_client = int(_flag("PADDLE_PS_REPLAY_CACHE")
+                               if per_client is None else per_client)
+        self._max_clients = int(max_clients)
+        self._clients: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def begin(self, rid):
+        """-> ("replay", reply) | ("wait", event) | ("run", None)."""
+        cid, seq = rid
+        with self._lock:
+            entries = self._clients.get(cid)
+            if entries is None:
+                entries = self._clients[cid] = OrderedDict()
+                while len(self._clients) > self._max_clients:
+                    _, evicted = self._clients.popitem(last=False)
+                    # wake any retry parked on an in-flight entry of the
+                    # evicted client — a fast "never committed" error
+                    # beats a 600s hang on an orphaned Event
+                    for state, pay in evicted.values():
+                        if state == self._PENDING:
+                            pay.set()
+            else:
+                self._clients.move_to_end(cid)
+            entry = entries.get(seq)
+            if entry is not None:
+                if entry[0] == self._DONE:
+                    return "replay", entry[1]
+                return "wait", entry[1]
+            entries[seq] = (self._PENDING, threading.Event())
+            return "run", None
+
+    def commit(self, rid, reply):
+        cid, seq = rid
+        with self._lock:
+            entries = self._clients.get(cid)
+            if entries is None:
+                return
+            entry = entries.get(seq)
+            entries[seq] = (self._DONE, reply)
+            entries.move_to_end(seq)
+            # evict oldest DONE entries only — a pending one belongs to a
+            # live handler that will commit into it
+            while len(entries) > self._per_client:
+                for k, v in entries.items():
+                    if v[0] == self._DONE and k != seq:
+                        del entries[k]
+                        break
+                else:
+                    break
+        if entry is not None and entry[0] == self._PENDING:
+            entry[1].set()
+
+    def lookup(self, rid):
+        cid, seq = rid
+        with self._lock:
+            entry = self._clients.get(cid, {}).get(seq)
+        if entry is not None and entry[0] == self._DONE:
+            return entry[1]
+        return None
+
+
+def _rid_of(req):
+    rid = req.pop("__rid__", None)
+    if rid is None:
+        return None
+    try:
+        cid, seq = rid
+        hash(seq)
+    except (TypeError, ValueError):
+        return None
+    return str(cid), seq
 
 
 def serve(endpoint: str, handler, stop_event: threading.Event):
     """Accept loop: one daemon thread per connection, each dispatching
     framed requests to handler(method, kwargs) until the peer closes or
-    stop_event fires. Returns the bound port (endpoint may say :0)."""
+    stop_event fires. Returns the bound port (endpoint may say :0).
+
+    Per-connection fault policy: a garbled/oversized frame gets a
+    best-effort error reply, bumps ps.rpc.bad_frames, and drops ONLY that
+    connection (the stream past it is desynced) — the server and its
+    other connections keep running. `__ping__` is answered before auth.
+    Requests carrying a replay id go through the shared ReplayCache so a
+    retried mutation is applied exactly once."""
     host, port = endpoint.rsplit(":", 1)
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -186,18 +474,84 @@ def serve(endpoint: str, handler, stop_event: threading.Event):
     bound = srv.getsockname()[1]
 
     token = os.environ.get("PADDLE_PS_TOKEN")
+    replay = ReplayCache()
+
+    def _serve_one(conn, method, req):
+        """Run the handler (through the replay cache when the request is
+        stamped) and send the reply, honoring injected reply faults.
+        Returns False when the connection must close."""
+        rid = _rid_of(req)
+        reply = None
+        if rid is not None:
+            state, payload = replay.begin(rid)
+            if state == "replay":
+                _monitor.stat_add("ps.rpc.replays")
+                reply = payload
+            elif state == "wait":
+                # the original attempt is still executing on another
+                # connection thread — parking beats double-applying
+                payload.wait(timeout=600.0)
+                reply = replay.lookup(rid)
+                if reply is None:
+                    reply = {"error": "ps rpc: in-flight original never "
+                                      "committed (server overloaded?)"}
+                else:
+                    _monitor.stat_add("ps.rpc.replays")
+        if reply is None:
+            try:
+                result = handler(method, req)
+                reply = {"result": result}
+            except Exception as e:  # noqa: BLE001 — reported to peer
+                reply = {"error": f"{type(e).__name__}: {e}"}
+            if rid is not None:
+                # commit BEFORE the reply leaves: if the response is lost
+                # from here on, the retry replays instead of re-applying
+                replay.commit(rid, reply)
+        try:
+            act = _fault("server", "reply", method)
+        except ConnectionError:
+            return False            # injected reset at the reply boundary
+        if act == "drop":
+            return False            # applied, but the response is lost
+        if act == "garble":
+            conn.sendall(_HDR.pack(10) + b"\x00" * 10)
+            return True
+        if act == "oversize":
+            conn.sendall(_HDR.pack(1 << 41))
+            return False
+        send_msg(conn, reply)
+        return True
 
     def _conn_loop(conn):
         conn.settimeout(None)
         authed = not token
         try:
             while not stop_event.is_set():
-                req = recv_msg(conn)
-                if req is None:
+                try:
+                    req = recv_msg(conn)
+                except (FrameError, pickle.UnpicklingError) as e:
+                    _monitor.stat_add("ps.rpc.bad_frames")
+                    try:
+                        send_msg(conn, {"error": f"bad frame: {e}"})
+                    except OSError:
+                        pass
+                    break
+                # re-check AFTER the blocking recv: a request that raced
+                # shutdown must not be applied to a dying server's tables
+                # (the client will retry against the restarted one)
+                if req is None or stop_event.is_set():
+                    break
+                if not isinstance(req, dict) or "method" not in req:
+                    _monitor.stat_add("ps.rpc.bad_frames")
+                    send_msg(conn, {"error": "bad frame: no method"})
                     break
                 method = req.pop("method")
+                if method == "__ping__":
+                    # liveness probe, answered before auth by design
+                    send_msg(conn, {"result": "pong"})
+                    continue
                 if not authed:
-                    # first frame must be the token handshake
+                    # first real frame must be the token handshake
                     if method == "__auth__" and hmac.compare_digest(
                             str(req.get("token", "")), token):
                         authed = True
@@ -208,12 +562,11 @@ def serve(endpoint: str, handler, stop_event: threading.Event):
                 if method == "__auth__":
                     send_msg(conn, {"result": "ok"})
                     continue
-                try:
-                    result = handler(method, req)
-                    send_msg(conn, {"result": result})
-                except Exception as e:  # noqa: BLE001 — reported to peer
-                    send_msg(conn, {"error": f"{type(e).__name__}: {e}"})
-        finally:
+                if not _serve_one(conn, method, req):
+                    break
+        except OSError:
+            pass                    # peer vanished mid-reply: their retry
+        finally:                    # lands on a fresh connection
             conn.close()
 
     def _accept_loop():

@@ -54,6 +54,11 @@ class PSServer:
 
     def shutdown(self):
         self._stop.set()
+        # join the accept loop so the port is RELEASED when we return —
+        # an elastic restart rebinds the same endpoint immediately
+        if self._thread is not None and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=5.0)
 
     # ----------------------------------------------------------- handlers
     def _handle(self, method, req):
@@ -109,6 +114,11 @@ class PSServer:
             return t.wait(req["trainer_id"], req.get("timeout", 120.0))
         if method == "table_state":
             return t.state()
+        if method == "table_applied":
+            # how many pushes this table has APPLIED — replayed retries
+            # don't re-apply, so chaos tests can assert exactly-once
+            # through the public RPC surface
+            return int(getattr(t, "applied", 0))
         if method == "load_table_state":
             t.load_state(req["state"])
             return True

@@ -110,6 +110,9 @@ class DenseTable:
         self._slots = slot_init(shape, np.float32)
         self.lr = float(lr)
         self._lock = threading.Lock()
+        # count of APPLIED mutations (not replayed retries) — the
+        # observable behind the exactly-once chaos assertions
+        self.applied = 0
 
     def pull(self):
         with self._lock:
@@ -119,11 +122,16 @@ class DenseTable:
         grad = np.asarray(grad, np.float32).reshape(self.param.shape)
         with self._lock:
             self.param = self._apply(self.param, grad, self._slots, self.lr)
+            self.applied += 1
 
     def set(self, value):
         with self._lock:
-            self.param = np.asarray(value, np.float32).reshape(
+            # np.array, not asarray: RPC payloads arrive as READ-ONLY
+            # views over pickle-5 buffers, and the accessors update
+            # self.param in place
+            self.param = np.array(value, np.float32).reshape(
                 self.param.shape)
+            self.applied += 1
 
     def state(self):
         with self._lock:
@@ -133,8 +141,11 @@ class DenseTable:
 
     def load_state(self, st):
         with self._lock:
-            self.param = np.asarray(st["param"], np.float32)
-            self._slots = {k: np.asarray(v) for k, v in st["slots"].items()}
+            # np.array copies: state arriving over RPC (load_table_state)
+            # is a read-only pickle-5 buffer view, and accessors mutate
+            # param/slots in place
+            self.param = np.array(st["param"], np.float32)
+            self._slots = {k: np.array(v) for k, v in st["slots"].items()}
             self.lr = float(st.get("lr", self.lr))
 
 
@@ -164,6 +175,7 @@ class SparseTable:
         self._init_rows = _initializer(init, self.dim, seed)
         self.lr = float(lr)
         self._lock = threading.Lock()
+        self.applied = 0  # applied mutations; see DenseTable.applied
 
     def __len__(self):
         return len(self._index)
@@ -225,6 +237,7 @@ class SparseTable:
             self._data[idx] = block
             for k, v in slot_block.items():
                 self._slots[k][idx] = v
+            self.applied += 1
 
     def state(self):
         with self._lock:
@@ -242,7 +255,8 @@ class SparseTable:
         with self._lock:
             ids = [int(i) for i in st["ids"]]
             self._index = {i: pos for pos, i in enumerate(ids)}
-            self._data = np.asarray(st["values"], np.float32).reshape(
+            # np.array copies — see DenseTable.load_state
+            self._data = np.array(st["values"], np.float32).reshape(
                 len(ids), self.dim)
             self._slots = self._slot_init(len(ids))
             for i, s in (st.get("slots", {}) or {}).items():
@@ -274,6 +288,7 @@ class GeoSparseTable(SparseTable):
         with self._lock:
             self._ensure(keys)
             self._data[self._idx(keys)] += merged
+            self.applied += 1
 
 
 class BarrierTable:

@@ -180,9 +180,15 @@ def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,
     directory = directory or os.environ.get(
         "PADDLE_TPU_CHECKPOINT_DIR", "./paddle_tpu_auto_checkpoint")
     ckpt = TrainingCheckpoint(directory, keep=2, async_save=False)
-    last = ckpt.restore()
-    start = int(last["epoch"]) + 1 if last is not None else 0
-    for epoch in range(start, max_epoch_num):
-        yield epoch
-        ckpt.save(epoch, {"epoch": epoch}, force=True)
-        ckpt.wait()
+    try:
+        last = ckpt.restore()
+        start = int(last["epoch"]) + 1 if last is not None else 0
+        for epoch in range(start, max_epoch_num):
+            yield epoch
+            ckpt.save(epoch, {"epoch": epoch}, force=True)
+            ckpt.wait()
+    finally:
+        # finished OR abandoned (GeneratorExit lands here): release the
+        # orbax CheckpointManager and its worker thread — one leaked
+        # manager per training loop otherwise
+        ckpt.close()

@@ -1,0 +1,10 @@
+"""paddle_tpu.testing — deterministic test harnesses.
+
+`faults` scripts seeded fault injection into the PS transport so chaos
+suites (tests/test_ps_faults.py) and downstream users can prove their
+training loops survive resets, lost replies, stalls, and garbage on the
+wire without flaky sleeps or real network partitions.
+"""
+from . import faults
+
+__all__ = ["faults"]

@@ -25,6 +25,15 @@ except AttributeError:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1 "
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: seeded deterministic fault-injection suite "
+        "(paddle_tpu.testing.faults); fast enough to stay in tier-1")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_seed():
     import numpy as np

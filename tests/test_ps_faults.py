@@ -1,0 +1,456 @@
+"""Chaos suite for the fault-tolerant PS transport (ISSUE 2).
+
+Every fault here is INJECTED — seeded and scripted through
+paddle_tpu.testing.faults, no real network partitions, no flaky sleeps —
+so the suite is deterministic and fast enough for tier-1. The contract
+under test mirrors the reference's brpc channel guarantees
+(connect_timeout + retry policy + idempotent service handlers):
+
+- transient resets / lost replies / stalls are retried under a deadline,
+  and mutating calls apply EXACTLY ONCE via the server replay cache;
+- a stall past PADDLE_PS_CALL_TIMEOUT raises DeadlineExceeded naming the
+  method and endpoint once the retry budget is spent;
+- oversized / garbled frames are rejected cleanly on both ends;
+- a full 2-server training run threaded with faults plus a mid-run
+  server kill + snapshot restore ends bitwise-equal to a fault-free run;
+- the ps.rpc.* monitor counters tick so supervisors can see flakiness.
+"""
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import monitor
+from paddle_tpu.distributed.ps import PSClient, PSServer
+from paddle_tpu.distributed.ps import rpc
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.chaos
+
+DIM = 4
+
+# tight-but-safe chaos timings: per-attempt deadline far above an
+# in-process RPC (~1ms) yet small enough that deadline tests stay fast
+FAST = dict(timeout=5.0, max_retries=3, backoff_base=0.01,
+            backoff_max=0.05, connect_retry_s=5.0)
+
+
+def _sparse_spec(optimizer="sgd", lr=1.0):
+    return {"type": "sparse", "dim": DIM, "optimizer": optimizer,
+            "lr": lr, "init": "zeros"}
+
+
+def _dense_spec():
+    return {"type": "dense", "shape": (3, DIM), "optimizer": "sgd",
+            "lr": 0.1, "init": "zeros"}
+
+
+@pytest.fixture()
+def server():
+    srv = PSServer(tables={"emb": _sparse_spec(),
+                           "dense0": _dense_spec()})
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_injector():
+    yield
+    faults.uninstall()
+
+
+def _delta(before, name):
+    return monitor.stat_get(name) - before.get(name, 0)
+
+
+# ---------------------------------------------------------------- retry
+
+def test_retry_survives_connection_reset(server):
+    client = PSClient([server.endpoint], **FAST)
+    before = monitor.stats("ps.rpc.")
+    with faults.inject(faults.Fault("client", "send", faults.RESET,
+                                    method="pull_sparse", times=2)) as inj:
+        rows = client.pull_sparse("emb", [1, 2, 3])
+    assert rows.shape == (3, DIM)
+    assert inj.fired(faults.RESET) == 2
+    assert _delta(before, "ps.rpc.retries") >= 2
+    assert _delta(before, "ps.rpc.reconnects") >= 2
+    # counters are part of the public stats() surface
+    assert "ps.rpc.retries" in monitor.stats()
+    client.close()
+
+
+def test_reconnect_reruns_auth_handshake(server, monkeypatch):
+    # token read at serve() time is already set? serve() captured env at
+    # start — spin a dedicated server AFTER setting the token
+    monkeypatch.setenv("PADDLE_PS_TOKEN", "sekrit-chaos")
+    srv = PSServer(tables={"emb": _sparse_spec()})
+    srv.start()
+    try:
+        client = PSClient([srv.endpoint], **FAST)
+        with faults.inject(faults.Fault("client", "recv", faults.RESET,
+                                        method="pull_sparse")) as inj:
+            rows = client.pull_sparse("emb", [7])
+        assert rows.shape == (1, DIM)
+        assert inj.fired() == 1  # the re-dial re-ran __auth__ and served
+        client.close()
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------------------- exactly-once
+
+def test_dropped_reply_applies_push_exactly_once(server):
+    """THE keystone: the reply to push_sparse_grad is lost after the
+    server applied it; the client's retry must hit the replay cache, not
+    the optimizer."""
+    client = PSClient([server.endpoint], **FAST)
+    client.pull_sparse("emb", [1, 2, 3])          # materialize rows at 0
+    table = server.table("emb")
+    applied0 = table.applied
+    before = monitor.stats("ps.rpc.")
+    with faults.inject(faults.Fault("server", "reply", faults.DROP,
+                                    method="push_sparse_grad")) as inj:
+        client.push_sparse_grad("emb", [1, 2, 3],
+                                np.ones((3, DIM), np.float32))
+    assert inj.fired(faults.DROP) == 1
+    # applied once, replayed (not re-applied) on the retry
+    assert table.applied == applied0 + 1
+    assert client.table_applied("emb") == applied0 + 1
+    assert _delta(before, "ps.rpc.replays") >= 1
+    # sgd lr=1.0 from zeros: exactly one application == exactly -1.0
+    np.testing.assert_array_equal(
+        client.pull_sparse("emb", [1, 2, 3]),
+        -np.ones((3, DIM), np.float32))
+    client.close()
+
+
+def test_dropped_reply_dense_and_barrier_replay(server):
+    client = PSClient([server.endpoint], **FAST)
+    srv_table = server.table("dense0")
+    with faults.inject(
+            faults.Fault("server", "reply", faults.DROP,
+                         method="push_dense_grad"),
+            faults.Fault("server", "reply", faults.DROP,
+                         method="set_dense")) as inj:
+        client.set_dense("dense0", np.full((3, DIM), 5.0, np.float32))
+        client.push_dense_grad("dense0", np.ones((3, DIM), np.float32))
+    assert inj.fired(faults.DROP) == 2
+    # one set + one sgd step (lr=0.1): 5.0 - 0.1, not 5.0 - 0.2
+    np.testing.assert_allclose(client.pull_dense("dense0"),
+                               np.full((3, DIM), 4.9, np.float32))
+    assert srv_table.applied == 2
+    client.close()
+
+
+# --------------------------------------------------------- deadlines
+
+def test_stall_past_deadline_names_method_and_endpoint(server):
+    client = PSClient([server.endpoint], timeout=0.3, max_retries=1,
+                      backoff_base=0.01, backoff_max=0.02,
+                      connect_retry_s=2.0)
+    before = monitor.stats("ps.rpc.")
+    with faults.inject(faults.Fault("server", "reply", faults.STALL,
+                                    method="pull_dense", times=10,
+                                    delay=1.0)):
+        with pytest.raises(rpc.DeadlineExceeded) as ei:
+            client.pull_dense("dense0")
+    msg = str(ei.value)
+    assert "pull_dense" in msg and server.endpoint in msg
+    assert _delta(before, "ps.rpc.deadline_exceeded") >= 1
+    assert _delta(before, "ps.rpc.retries") >= 1
+    client.close()
+
+
+def test_stalled_mutation_is_rescued_by_replay(server):
+    """A stall on the REPLY of a mutating call: the first attempt times
+    out client-side after the server applied+committed, and the retry
+    replays the cached reply — the call SUCCEEDS and applies once."""
+    client = PSClient([server.endpoint], timeout=0.4, max_retries=2,
+                      backoff_base=0.01, backoff_max=0.02,
+                      connect_retry_s=2.0)
+    client.pull_sparse("emb", [9])
+    table = server.table("emb")
+    applied0 = table.applied
+    with faults.inject(faults.Fault("server", "reply", faults.STALL,
+                                    method="push_sparse_grad", times=1,
+                                    delay=1.0)):
+        client.push_sparse_grad("emb", [9], np.ones((1, DIM), np.float32))
+    assert table.applied == applied0 + 1
+    np.testing.assert_array_equal(client.pull_sparse("emb", [9]),
+                                  -np.ones((1, DIM), np.float32))
+    client.close()
+
+
+# ------------------------------------------------------------- frames
+
+def test_oversized_frame_rejected_without_allocation():
+    a, b = socket.socketpair()
+    try:
+        b.sendall(rpc._HDR.pack(1 << 45))   # 32 TiB claim
+        with pytest.raises(rpc.FrameError, match="PADDLE_PS_MAX_FRAME"):
+            rpc.recv_msg(a)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_oversized_send_refused():
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(rpc.FrameError, match="refusing to send"):
+            rpc.send_msg(a, {"x": np.zeros(1 << 12, np.uint8)},
+                         max_frame=1 << 10)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_garbled_frame_rejected_cleanly():
+    a, b = socket.socketpair()
+    try:
+        b.sendall(rpc._HDR.pack(10) + b"\x00" * 10)
+        with pytest.raises((rpc.FrameError, Exception)) as ei:
+            rpc.recv_msg(a)
+        # specifically a clean frame/pickle rejection, not an OOM/crash
+        import pickle
+        assert isinstance(ei.value, (rpc.FrameError,
+                                     pickle.UnpicklingError))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_server_survives_bad_frames_from_one_peer(server):
+    """A hostile/garbled connection is dropped per-connection; the server
+    keeps serving everyone else and counts the event."""
+    before = monitor.stats("ps.rpc.")
+    host, port = server.endpoint.rsplit(":", 1)
+    evil = socket.create_connection((host, int(port)), timeout=5.0)
+    evil.sendall(rpc._HDR.pack(1 << 45))
+    evil.settimeout(5.0)
+    # server answers with a best-effort error frame and/or closes; either
+    # way the stream ends rather than allocating 32 TiB
+    try:
+        data = evil.recv(1 << 16)
+        if data:
+            assert b"bad frame" in data
+    except OSError:
+        pass
+    evil.close()
+    assert _delta(before, "ps.rpc.bad_frames") >= 1
+    # a well-behaved client is unaffected
+    client = PSClient([server.endpoint], **FAST)
+    assert client.pull_sparse("emb", [4]).shape == (1, DIM)
+    assert client.ping()[0] < 5.0
+    client.close()
+
+
+def test_garbled_reply_triggers_retry(server):
+    client = PSClient([server.endpoint], **FAST)
+    with faults.inject(faults.Fault("server", "reply", faults.GARBLE,
+                                    method="pull_sparse")) as inj:
+        rows = client.pull_sparse("emb", [11])
+    assert inj.fired(faults.GARBLE) == 1
+    assert rows.shape == (1, DIM)
+    client.close()
+
+
+def test_ping_served_before_auth(monkeypatch):
+    monkeypatch.setenv("PADDLE_PS_TOKEN", "sekrit-ping")
+    stop = threading.Event()
+    port, _ = rpc.serve("127.0.0.1:0", lambda m, kw: None, stop)
+    try:
+        # a tokenless probe: no __auth__ frame, just __ping__
+        monkeypatch.delenv("PADDLE_PS_TOKEN")
+        sock = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        rpc.send_msg(sock, {"method": "__ping__"})
+        assert rpc.recv_msg(sock) == {"result": "pong"}
+        # ...but real methods still require the handshake
+        rpc.send_msg(sock, {"method": "pull_dense", "table": "x"})
+        reply = rpc.recv_msg(sock)
+        assert reply and "auth required" in reply.get("error", "")
+        sock.close()
+    finally:
+        stop.set()
+
+
+# ------------------------------------------------- chaos training run
+
+N_STEPS = 24
+SNAP_STEP = 11          # snapshot lands after this step's pushes
+KILL_STEP = 17          # server 0 dies after this step completes
+VOCAB = 64
+
+
+def _train_steps(client, start, stop_, snap_path=None):
+    """Deterministic 2-table loop; grads depend on PULLED state, so any
+    lost or double-applied update poisons every later step."""
+    for step in range(start, stop_):
+        rng = np.random.RandomState(1000 + step)
+        ids = rng.randint(0, VOCAB, size=10).astype(np.int64)
+        rows = client.pull_sparse("emb", ids)
+        grads = rows * 0.05 + rng.randn(len(ids), DIM).astype(np.float32)
+        client.push_sparse_grad("emb", ids, grads)
+        dense = client.pull_dense("dense0")
+        client.push_dense_grad(
+            "dense0", dense * 0.05 + rng.randn(3, DIM).astype(np.float32))
+        if step == SNAP_STEP and snap_path:
+            client.save_snapshot(snap_path)
+
+
+def _final_state(client):
+    all_ids = np.arange(VOCAB, dtype=np.int64)
+    return (client.pull_sparse("emb", all_ids).copy(),
+            client.pull_dense("dense0").copy())
+
+
+def _spawn_servers(ports):
+    servers = []
+    for p in ports:
+        srv = PSServer(endpoint=f"127.0.0.1:{p}",
+                       tables={"emb": _sparse_spec("adagrad", lr=0.1),
+                               "dense0": _dense_spec()})
+        srv.start()
+        servers.append(srv)
+    return servers
+
+
+def test_chaos_training_bitwise_equals_fault_free(tmp_path):
+    """2-server PS training with seeded resets + dropped replies AND a
+    mid-run server kill + snapshot-restore: the final dense and sparse
+    tables must be BITWISE equal to a fault-free run — no lost, no
+    double-applied gradients."""
+    # ---- fault-free reference run
+    ref_servers = _spawn_servers((0, 0))
+    ref_client = PSClient([s.endpoint for s in ref_servers], **FAST)
+    _train_steps(ref_client, 0, N_STEPS,
+                 snap_path=str(tmp_path / "ref_snap"))
+    ref_sparse, ref_dense = _final_state(ref_client)
+    ref_client.close()
+    for s in ref_servers:
+        s.shutdown()
+
+    # ---- chaos run: seeded resets + lost replies through every step
+    servers = _spawn_servers((0, 0))
+    endpoints = [s.endpoint for s in servers]
+    client = PSClient(endpoints, **FAST)
+    before = monitor.stats("ps.rpc.")
+    snap = str(tmp_path / "chaos_snap")
+    with faults.inject(seed=7, p={faults.RESET: 0.04,
+                                  faults.DROP: 0.04}) as inj:
+        _train_steps(client, 0, KILL_STEP + 1, snap_path=snap)
+
+        # ---- mid-run crash of server 0, restart on the SAME endpoint
+        servers[0].shutdown()
+        fresh = _spawn_servers((int(endpoints[0].rsplit(":", 1)[1]),))[0]
+        servers[0] = fresh
+        # global rollback to the snapshot, replay the suffix — the
+        # standard PS recovery the reference's HeartBeatMonitor +
+        # large_scale_kv checkpointing enable
+        client.load_snapshot(snap)
+        _train_steps(client, SNAP_STEP + 1, N_STEPS)
+
+    got_sparse, got_dense = _final_state(client)
+    # the chaos actually happened...
+    assert inj.fired(faults.DROP) >= 1, "seed injected no drops"
+    assert inj.fired(faults.RESET) >= 1, "seed injected no resets"
+    # ...the transport reported it through the monitor...
+    assert _delta(before, "ps.rpc.retries") >= 1
+    assert _delta(before, "ps.rpc.reconnects") >= 1
+    assert _delta(before, "ps.rpc.replays") >= 1
+    # ...and not one gradient was lost or double-counted
+    np.testing.assert_array_equal(got_sparse, ref_sparse)
+    np.testing.assert_array_equal(got_dense, ref_dense)
+    client.close()
+    for s in servers:
+        s.shutdown()
+
+
+def test_chaos_run_is_seed_deterministic():
+    """Same seed -> same injected fault sequence per stream (the
+    scripted-chaos determinism the harness promises downstream tests)."""
+    a = faults.FaultInjector(seed=42, p={faults.DROP: 0.5})
+    b = faults.FaultInjector(seed=42, p={faults.DROP: 0.5})
+    seq_a = [a.on_event("server", "reply", "push_sparse_grad")
+             for _ in range(64)]
+    seq_b = [b.on_event("server", "reply", "push_sparse_grad")
+             for _ in range(64)]
+    assert seq_a == seq_b
+    assert seq_a.count("drop") > 0
+    c = faults.FaultInjector(seed=43, p={faults.DROP: 0.5})
+    seq_c = [c.on_event("server", "reply", "push_sparse_grad")
+             for _ in range(64)]
+    assert seq_a != seq_c
+
+
+def test_two_communicators_share_client_without_replay_collision(server):
+    """Replay keys are namespaced per Communicator: a second instance
+    over the SAME PSClient restarts its batch numbering, and its pushes
+    must apply — not be mistaken for replays of the first one's."""
+    from paddle_tpu.distributed.ps import Communicator
+    client = PSClient([server.endpoint], **FAST)
+    client.pull_sparse("emb", [5])
+    table = server.table("emb")
+    applied0 = table.applied
+    for _ in range(2):
+        comm = Communicator(client, send_every=1, max_queue=8,
+                            max_delay_s=0.01)
+        comm.push_sparse("emb", [5], np.ones((1, DIM), np.float32))
+        comm.flush(timeout=30.0)
+        comm.stop()
+    assert table.applied == applied0 + 2
+    np.testing.assert_array_equal(client.pull_sparse("emb", [5]),
+                                  -2.0 * np.ones((1, DIM), np.float32))
+    client.close()
+
+
+def test_oversized_request_fails_fast_without_retry(server):
+    """A request over the frame bound is a deterministic LOCAL error:
+    FrameError immediately, no retries, no reconnect churn."""
+    client = PSClient([server.endpoint], **FAST)
+    client.pull_sparse("emb", [1])          # connection warm and healthy
+    before = monitor.stats("ps.rpc.")
+    from paddle_tpu.core.flags import set_flags
+    set_flags({"PADDLE_PS_MAX_FRAME": 4096})
+    try:
+        with pytest.raises(rpc.FrameError, match="PADDLE_PS_MAX_FRAME"):
+            client.push_sparse_grad(
+                "emb", np.arange(4096, dtype=np.int64),
+                np.ones((4096, DIM), np.float32))
+    finally:
+        set_flags({"PADDLE_PS_MAX_FRAME": 1 << 30})
+    assert _delta(before, "ps.rpc.retries") == 0
+    assert _delta(before, "ps.rpc.reconnects") == 0
+    # the connection is still usable afterwards
+    assert client.pull_sparse("emb", [1]).shape == (1, DIM)
+    client.close()
+
+
+def test_communicator_retries_through_faults(server):
+    """The async send thread rides the retrying transport: a reset +
+    dropped reply under its merged batch neither kills the thread nor
+    double-applies."""
+    from paddle_tpu.distributed.ps import Communicator
+    client = PSClient([server.endpoint], **FAST)
+    client.pull_sparse("emb", [1, 2])
+    table = server.table("emb")
+    applied0 = table.applied
+    comm = Communicator(client, send_every=2, max_queue=16,
+                        max_delay_s=0.01)
+    with faults.inject(
+            faults.Fault("client", "send", faults.RESET,
+                         method="push_sparse_grad"),
+            faults.Fault("server", "reply", faults.DROP,
+                         method="push_sparse_grad")):
+        comm.push_sparse("emb", [1], np.ones((1, DIM), np.float32))
+        comm.push_sparse("emb", [2], np.ones((1, DIM), np.float32))
+        comm.flush(timeout=30.0)
+    comm.stop()
+    # one merged batch, applied exactly once despite both faults
+    assert table.applied == applied0 + 1
+    np.testing.assert_array_equal(client.pull_sparse("emb", [1, 2]),
+                                  -np.ones((2, DIM), np.float32))
+    client.close()

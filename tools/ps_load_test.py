@@ -51,6 +51,13 @@ def main():
         "emb": {"type": "sparse", "dim": DIM, "optimizer": "sgd",
                 "lr": 0.1, "init": "zeros"}})
     srv.start()
+    # PS_LOAD_CHAOS=<seed> measures throughput UNDER seeded faults
+    # (resets + dropped replies), i.e. the retry/replay path's overhead
+    chaos_seed = os.environ.get("PS_LOAD_CHAOS")
+    if chaos_seed is not None:
+        from paddle_tpu.testing import faults
+        faults.install(faults.FaultInjector(
+            seed=chaos_seed, p={faults.RESET: 0.01, faults.DROP: 0.01}))
     try:
         endpoints = [srv.endpoint]
         results = {}
@@ -75,6 +82,9 @@ def main():
     print(f"pull rows/sec: {pull_sec:,.0f}")
     print(f"push rows/sec: {push_sec:,.0f}")
     print(f"aggregate rows/sec: {rows_sec:,.0f} (wall {wall:.2f}s)")
+    from paddle_tpu.core import monitor
+    health = {k: int(v) for k, v in sorted(monitor.stats("ps.").items())}
+    print(f"transport health counters: {health or 'all zero'}")
 
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "ps_throughput.md")
