@@ -71,6 +71,12 @@ define_flag("FLAGS_log_memory_estimate", False,
             "on each fresh Executor lowering, run the liveness-based "
             "peak-memory estimator (static/shape_infer.py analyze_memory) "
             "and publish executor/estimated_peak_bytes to the monitor")
+define_flag("FLAGS_log_spmd_estimate", False,
+            "on each fresh Executor lowering with a registered mesh, run "
+            "the SPMD sharding analyzer (static/spmd_analyzer.py) and "
+            "publish the spmd.{collective_bytes,hbm_estimate,"
+            "resharding_count} monitor gauges (non-strict; set "
+            "PADDLE_TPU_VERIFY_SPMD=1 to FAIL compilation on findings)")
 define_flag("FLAGS_use_flash_attention", True,
             "route attention through the Pallas flash kernel on TPU "
             "(paddle_tpu.ops.pallas.flash_attention)")

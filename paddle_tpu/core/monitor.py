@@ -13,7 +13,8 @@ from __future__ import annotations
 import threading
 from collections import defaultdict
 
-__all__ = ["stat_add", "stat_set", "stat_get", "stats", "reset"]
+__all__ = ["stat_add", "stat_set", "stat_set_many", "stat_get", "stats",
+           "reset"]
 
 _lock = threading.Lock()
 _stats = defaultdict(float)
@@ -28,6 +29,14 @@ def stat_add(name: str, value=1):
 def stat_set(name: str, value):
     with _lock:
         _stats[name] = value
+
+
+def stat_set_many(values: dict):
+    """Set a group of gauges atomically (one lock round-trip) — e.g. the
+    spmd.{collective_bytes,hbm_estimate,resharding_count} trio published
+    by static/spmd_analyzer.py SpmdReport.publish()."""
+    with _lock:
+        _stats.update(values)
 
 
 def stat_get(name: str):

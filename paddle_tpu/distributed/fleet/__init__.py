@@ -29,7 +29,7 @@ __all__ = ["init", "DistributedStrategy", "PaddleCloudRoleMaker",
            "worker_endpoints", "barrier_worker", "init_worker",
            "stop_worker", "init_server", "run_server", "ps_client",
            "ps_communicator", "DistributedOptimizer",
-           "get_hybrid_communicate_group"]
+           "get_hybrid_communicate_group", "spmd_report"]
 
 _fleet_state = {
     "initialized": False,
@@ -96,6 +96,40 @@ def init(role_maker=None, is_collective=True, strategy=None):
     mesh_mod.init_mesh(shape)
     _fleet_state["hcg"] = HybridCommunicateGroup(shape)
     return _FleetFacade()
+
+
+def spmd_report(program=None, layer=None, mesh=None, data_specs=None,
+                tokens_per_step=None, zero_dp=False):
+    """Run the static SPMD sharding analyzer against the fleet mesh
+    (static/spmd_analyzer.py): resolved PartitionSpecs, the implied
+    collective set with per-device payload bytes, a per-device peak-HBM
+    estimate, and the sharding diagnostic catalogue — all before jit.
+
+    Pass a static `program` (optionally with a `layer` so the TP name
+    patterns see dotted parameter paths), or just a `layer`/param tree
+    for the dygraph/hapi path. `mesh` defaults to the fleet-declared
+    mesh; an {axis: size} dict also works (no devices needed — lint a
+    pod layout from a dev box)."""
+    from ...static import spmd_analyzer as spmd
+    from .. import sharding as sharding_mod
+    mesh = mesh if mesh is not None else mesh_mod.get_mesh()
+    if program is not None:
+        param_specs = getattr(program, "spmd_param_specs", None)
+        if param_specs is None and layer is not None:
+            param_specs = sharding_mod.named_param_specs(
+                layer, mesh, zero_dp=zero_dp)
+        if data_specs is None:  # same defaulting as the VERIFY_SPMD hook
+            data_specs = getattr(program, "spmd_data_specs", None)
+        return spmd.analyze_program(program, mesh=mesh,
+                                    param_specs=param_specs,
+                                    data_specs=data_specs)
+    if layer is None:
+        raise ValueError("spmd_report needs a program or a layer")
+    params = dict(layer.named_parameters()) if hasattr(
+        layer, "named_parameters") else dict(layer)
+    return spmd.analyze_params(params, mesh=mesh,
+                               tokens_per_step=tokens_per_step,
+                               zero_dp=zero_dp)
 
 
 class HybridCommunicateGroup:

@@ -162,15 +162,49 @@ def mesh_axis_size(axis: str, name: str = None) -> int:
     return m.shape[axis]
 
 
-def in_spmd_region(axis: str = None) -> bool:
-    """True when tracing inside shard_map where `axis` is bound —
-    i.e. lax.psum(axis) is legal here."""
+def _axis_env_names():
+    """Bound mesh-axis names of the current trace context, via the
+    private jax accessor (the fast path; raises ImportError/AttributeError
+    on jax versions that moved it — callers must fall back, NOT swallow)."""
+    from jax._src.core import get_axis_env
+    return tuple(get_axis_env().axis_names())
+
+
+def _axis_bound_probe(axis: str) -> bool:
+    """Public-API fallback: `lax.psum(axis)` is legal exactly when `axis`
+    is bound here, and `jax.eval_shape` asks that question abstractly
+    (no op enters the enclosing trace). An unbound name raises NameError;
+    anything else jax raises for a malformed probe also means 'not a
+    bound SPMD axis'."""
+    import jax.numpy as jnp
     try:
-        from jax._src.core import get_axis_env
-        env = get_axis_env()
-        names = env.axis_names()
+        jax.eval_shape(lambda: jax.lax.psum(jnp.zeros((), jnp.float32),
+                                            axis))
+        return True
+    except NameError:
+        return False
     except Exception:
         return False
+
+
+def in_spmd_region(axis: str = None) -> bool:
+    """True when tracing inside shard_map where `axis` is bound —
+    i.e. lax.psum(axis) is legal here.
+
+    Prefers the private jax axis-env accessor; when a jax version moves
+    it, degrades to a public-API probe (eval_shape over lax.psum) that
+    still answers correctly for named axes. With axis=None the fallback
+    probes every registered mesh's axes (plus the conventional five) —
+    a correct answer for any axis this framework could have bound."""
+    try:
+        names = _axis_env_names()
+    except (ImportError, AttributeError):
+        if axis is not None:
+            return _axis_bound_probe(axis)
+        with _lock:
+            candidates = {a for m in _meshes.values() for a in m.axis_names}
+        candidates |= {"dp", "tp", "pp", "sp", "ep"}
+        return any(_axis_bound_probe(a) for a in sorted(candidates))
     if axis is None:
         return bool(names)
     return axis in names
@@ -184,9 +218,20 @@ class MeshGuard:
     """`with MeshGuard(mesh):` — scope the jax mesh context manager."""
 
     def __init__(self, mesh: Mesh = None, name: str = None):
+        self.name = name
         self.mesh = mesh or get_mesh(name)
 
     def __enter__(self):
+        if self.mesh is None:
+            with _lock:
+                have = sorted(_meshes)
+            want = self.name if self.name is not None else \
+                "<default>"
+            raise RuntimeError(
+                f"MeshGuard: no mesh named {want!r} in the mesh registry "
+                f"(registered: {have or 'none'}). Declare one with "
+                "init_mesh({'dp': n, ...}) / init_hybrid_mesh(...) or "
+                "pass a Mesh explicitly: MeshGuard(mesh)")
         self._cm = self.mesh
         self._cm.__enter__()
         return self.mesh

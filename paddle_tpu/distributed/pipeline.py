@@ -28,7 +28,7 @@ from jax import lax
 from . import mesh as mesh_mod
 
 __all__ = ["micro_batch", "gpipe", "interleaved", "pipeline_loss",
-           "bubble_fraction", "schedule_ticks"]
+           "bubble_fraction", "schedule_ticks", "schedule_collectives"]
 
 
 def micro_batch(x, num_micro):
@@ -185,3 +185,19 @@ def bubble_fraction(num_micro: int, num_stages: int) -> float:
     """Pipeline bubble overhead (n-1)/(M+n-1) — the schedule-quality
     accounting the reference leaves implicit in SectionWorker."""
     return (num_stages - 1) / (num_micro + num_stages - 1)
+
+
+def schedule_collectives(num_micro: int, num_stages: int,
+                         hidden_bytes: int, schedule: str = "gpipe",
+                         num_virtual: int = 1, axis: str = "pp") -> dict:
+    """The pipeline's implied collective set, in the static analyzer's
+    terms (static/spmd_analyzer.py): every schedule above emits ONE
+    lax.ppermute of the hidden microbatch per tick, so the 'pp' wire
+    cost of a step is ticks x hidden_bytes — the quantity the analyzer's
+    collective table and tools/spmd_lint.py report next to the
+    matmul-implied all-reduces. (The forward numbers; AD mirrors each
+    ppermute in reverse, doubling the wire bytes for training.)"""
+    ticks = schedule_ticks(num_micro, num_stages, schedule, num_virtual)
+    return {"kind": "ppermute", "axis": axis, "count": ticks,
+            "bytes_per_tick": int(hidden_bytes),
+            "total_bytes": ticks * int(hidden_bytes)}

@@ -26,7 +26,8 @@ from . import mesh as mesh_mod
 
 __all__ = ["param_spec_for", "build_param_shardings", "COLUMN_PARALLEL",
            "ROW_PARALLEL", "VOCAB_PARALLEL", "add_tp_rule",
-           "shard_optimizer_state", "group_sharded_parallel"]
+           "shard_optimizer_state", "group_sharded_parallel",
+           "named_param_specs", "mesh_like"]
 
 COLUMN_PARALLEL = [
     r"qkv_proj\.weight$", r"q_proj\.weight$", r"k_proj\.weight$",
@@ -89,22 +90,82 @@ def build_param_shardings(params: Dict[str, "jax.Array"],
     out = {}
     for name, v in params.items():
         spec = param_spec_for(name, v.ndim, m, zero_dp=zero_dp)
-        spec = _validate_divisible(spec, v.shape, m)
+        spec = _validate_divisible(spec, v.shape, m, name=name)
         out[name] = NamedSharding(m, spec)
     return out
 
 
-def _validate_divisible(spec: P, shape, mesh: Mesh) -> P:
+def _validate_divisible(spec: P, shape, mesh: Mesh, name: str = None) -> P:
     """Drop axis shardings that don't divide the dim (falls back to
-    replication for that dim, like GSPMD would pad — we prefer explicit)."""
+    replication for that dim, like GSPMD would pad — we prefer explicit).
+
+    The fallback is no longer silent: each dropped axis bumps the
+    `sharding.nondivisible_fallback` monitor counter (the static
+    analyzer reports the same condition as a `non-divisible` diagnostic
+    before compilation). A spec with MORE entries than the tensor has
+    dims is a caller bug and raises — trailing axes used to be
+    zip-truncated without complaint."""
+    entries = tuple(spec)
+    if len(entries) > len(shape):
+        raise ValueError(
+            f"PartitionSpec {spec} has {len(entries)} entries but "
+            f"{'param ' + repr(name) + ' ' if name else ''}shape "
+            f"{tuple(shape)} has only {len(shape)} dims — trailing axes "
+            "would be silently dropped")
     new = []
-    for dim, ax in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+    for dim, ax in zip(shape,
+                       entries + (None,) * (len(shape) - len(entries))):
         if ax is None:
             new.append(None)
         else:
-            size = mesh.shape[ax] if ax in mesh.axis_names else 1
-            new.append(ax if dim % size == 0 else None)
+            axes = (ax,) if isinstance(ax, str) else tuple(ax)
+            size = 1
+            for a in axes:
+                size *= mesh.shape[a] if a in mesh.axis_names else 1
+            if dim % size == 0:
+                new.append(ax)
+            else:
+                from ..core import monitor as _monitor
+                _monitor.stat_add("sharding.nondivisible_fallback")
+                new.append(None)
     return P(*new)
+
+
+def mesh_like(mesh):
+    """Normalize a mesh argument for spec derivation: a real Mesh passes
+    through, an {axis: size} dict becomes a duck-typed stand-in with
+    .axis_names/.shape (no devices needed — the static analyzer and spec
+    helpers only read the axis layout), None resolves the registered
+    default."""
+    if mesh is None:
+        return mesh_mod.get_mesh()
+    if isinstance(mesh, dict):
+        from types import SimpleNamespace
+        return SimpleNamespace(axis_names=tuple(mesh), shape=dict(mesh))
+    return mesh
+
+
+def named_param_specs(layer, mesh=None, zero_dp=False, by="storage"):
+    """PartitionSpecs for a Layer's parameters, keyed for downstream use.
+
+    The TP rules above match DOTTED module paths ('blocks.0.fc2.weight'),
+    but a static Program stores persistables under their scope names and
+    dygraph params under their tensor names — this walks
+    `layer.named_parameters()` once and returns {storage_name: spec}
+    (by="storage", feeds `Program.spmd_param_specs` / analyze_program) or
+    {dotted_name: spec} (by="dotted", feeds analyze_params).
+
+    mesh may be a Mesh, an {axis: size} dict (no devices needed), or
+    None for the registered default.
+    """
+    mesh = mesh_like(mesh)
+    out = {}
+    for dotted, p in layer.named_parameters():
+        spec = param_spec_for(dotted, len(p.shape), mesh, zero_dp=zero_dp)
+        key = dotted if by == "dotted" else (
+            getattr(p, "scope_name", None) or getattr(p, "name", dotted))
+        out[key] = spec
+    return out
 
 
 def shard_optimizer_state(slot_tree: Dict[str, Dict[str, "jax.Array"]],

@@ -21,6 +21,11 @@ from .program import (Program, Variable, StaticParam, default_main_program,  # n
                       name_scope, program_guard)
 from .shape_infer import (ShapeInferError, analyze_memory,  # noqa: F401
                           infer_program, register_infer_rule)
+from .spmd_analyzer import (Collective, SpmdDiagnostic,  # noqa: F401
+                            SpmdLintError, SpmdReport, analyze_params,
+                            analyze_program, maybe_verify_spmd,
+                            register_spmd_rule, set_verify_spmd,
+                            verify_spmd_enabled)
 from .verifier import ProgramVerifyError, verify_program  # noqa: F401
 
 __all__ = ["data", "InputSpec", "Program", "Variable", "Executor",
@@ -31,7 +36,11 @@ __all__ = ["data", "InputSpec", "Program", "Variable", "Executor",
            "save_inference_model", "load_inference_model",
            "cpu_places", "cuda_places",
            "verify_program", "ProgramVerifyError", "infer_program",
-           "ShapeInferError", "register_infer_rule", "analyze_memory"]
+           "ShapeInferError", "register_infer_rule", "analyze_memory",
+           "analyze_program", "analyze_params", "SpmdLintError",
+           "SpmdReport", "SpmdDiagnostic", "Collective",
+           "register_spmd_rule", "set_verify_spmd", "verify_spmd_enabled",
+           "maybe_verify_spmd"]
 
 
 def data(name, shape, dtype="float32", lod_level=0):

@@ -113,6 +113,12 @@ class Executor:
         if entry is None:
             from .. import profiler as _prof
             from ..core import monitor as _monitor
+            # PADDLE_TPU_VERIFY_SPMD: sharding findings (unbound axis,
+            # non-divisible dim, implied reshard, ...) fail HERE — before
+            # jit tracing, where they would surface as silent replication
+            # or an opaque XLA error (mirrors PADDLE_TPU_VERIFY_PASSES)
+            from .spmd_analyzer import maybe_verify_spmd
+            spmd_rep = maybe_verify_spmd(program)
             with _prof.RecordEvent("executor/lower_program"):
                 entry = self._compile(program, sorted(feed_vals), fetch_ids,
                                       data_parallel)
@@ -124,6 +130,18 @@ class Executor:
                 est = analyze_memory(program)
                 _monitor.stat_set("executor/estimated_peak_bytes",
                                   est["peak_bytes"])
+            # spmd_rep already published the gauges when the strict hook
+            # ran — don't re-walk the program for the same numbers
+            if _flags0.flag("FLAGS_log_spmd_estimate") and spmd_rep is None:
+                from ..distributed import mesh as _mesh_mod
+                if _mesh_mod.get_mesh() is not None:
+                    from .spmd_analyzer import analyze_program
+                    analyze_program(
+                        program,
+                        param_specs=getattr(program, "spmd_param_specs",
+                                            None),
+                        data_specs=getattr(program, "spmd_data_specs",
+                                           None)).publish()
         step, persist_names, opt, amp_init = entry
 
         for n, v0 in (amp_init or {}).items():

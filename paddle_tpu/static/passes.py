@@ -96,6 +96,14 @@ def apply_pass(program, names):
         if verify:
             from .verifier import verify_program
             verify_program(program, pass_name=n)
+    if isinstance(program, Program):
+        # PADDLE_TPU_VERIFY_SPMD (default off, mirroring the env-var
+        # contract above): the rewritten program's declared shardings
+        # must still analyze clean — a pass that reorders or rewires a
+        # sharded matmul fails HERE with a named SpmdLintError, not as
+        # an unplanned all-gather after jit
+        from .spmd_analyzer import maybe_verify_spmd
+        maybe_verify_spmd(program)
     return program
 
 

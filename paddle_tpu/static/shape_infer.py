@@ -217,7 +217,8 @@ def infer_program(program: Program, check: bool = True,
 # ---------------------------------------------------------------------------
 
 def analyze_memory(program: Program,
-                   env: Optional[dict] = None) -> dict:
+                   env: Optional[dict] = None,
+                   shard_divisors: Optional[Dict[int, int]] = None) -> dict:
     """Estimate the lowered step's peak residency from inferred avals.
 
     Liveness at the Program level (the reference's
@@ -226,6 +227,11 @@ def analyze_memory(program: Program,
     the program when it is fetched, state-written, or feeds the backward
     section. Persistables (params) and feeds are resident throughout.
 
+    shard_divisors ({var_id: divisor}) turns the estimate PER-DEVICE
+    under SPMD partitioning: each var's bytes are divided by the product
+    of its sharded dims' mesh-axis sizes (supplied by
+    static/spmd_analyzer.py from the propagated PartitionSpecs).
+
     Returns {"peak_bytes", "param_bytes", "feed_bytes",
     "activation_peak_bytes", "timeline": [(op_name, live_bytes)],
     "peak_op"}; a pure estimate — XLA's buffer assignment (fusion,
@@ -233,12 +239,18 @@ def analyze_memory(program: Program,
     """
     if env is None:
         env = infer_program(program, check=False, amp_check=False)
+    divs = shard_divisors or {}
+
+    def _nb(vid, aval):
+        return _nbytes(aval) // max(int(divs.get(vid, 1)), 1)
+
     param_bytes = 0
     for scope_name, vid in program.persist_ids.items():
         pv = program.persistable_vars.get(scope_name)
         if pv is not None:
-            param_bytes += _nbytes(pv.aval)
-    feed_bytes = sum(_nbytes(v.aval) for v in program.data_vars.values())
+            param_bytes += _nb(vid, pv.aval)
+    feed_bytes = sum(_nb(v.var_id, v.aval)
+                     for v in program.data_vars.values())
 
     n = len(program.ops)
     roots = set(program.state_writes.values())
@@ -267,7 +279,7 @@ def analyze_memory(program: Program,
     for i, op in enumerate(program.ops):
         for oid in op.out_ids:
             if oid in env and last_use.get(oid, -1) >= i:
-                b = _nbytes(env[oid])
+                b = _nb(oid, env[oid])
                 live_now[oid] = b
                 live_bytes += b
         total = param_bytes + feed_bytes + live_bytes

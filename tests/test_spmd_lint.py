@@ -1,0 +1,48 @@
+"""tools/spmd_lint.py in-process (ISSUE 3 satellite): the golden GPT TP
+config must lint clean (this test IS the tier-1 invocation, as
+test_framework_lint is for the framework gate), every --inject seam must
+produce its named diagnostic and a failing exit code, and the tool must
+be wired into framework_lint's cross-check registry."""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import framework_lint  # noqa: E402
+import spmd_lint  # noqa: E402
+
+
+def test_golden_config_is_clean(capsys):
+    assert spmd_lint.self_check() == []
+    assert spmd_lint.main([]) == 0
+    out = capsys.readouterr().out
+    assert "all_reduce" in out and "diagnostics: none" in out
+    assert "per-device HBM estimate" in out
+
+
+def test_report_contents():
+    report, program, logits = spmd_lint.build_report(tp=2, layers=2)
+    assert report.mesh_axes == {"tp": 2}
+    ar = [c for c in report.collectives if c.kind == "all_reduce"]
+    assert len(ar) == 5 and all(c.bytes > 0 for c in ar)
+    assert report.hbm["peak_bytes"] < report.hbm_replicated["peak_bytes"]
+
+
+def test_injections_fail_with_named_diagnostic(capsys):
+    for inject in spmd_lint.INJECTIONS:
+        assert spmd_lint.main(["--inject", inject]) == 1
+        out = capsys.readouterr().out
+        assert inject in out, f"--inject {inject} did not surface {inject}"
+
+
+def test_pp_wire_cost_reported(capsys):
+    assert spmd_lint.main(["--pp", "4", "--micro", "8"]) == 0
+    out = capsys.readouterr().out
+    assert "ppermute" in out and "11" in out  # 8 + 4 - 1 ticks
+
+
+def test_registered_in_framework_lint_cross_checks():
+    assert "spmd_lint" in framework_lint.TOOL_CROSS_CHECKS
+    # and the registry check actually ran it (clean repo -> no findings)
+    assert framework_lint.check_registered_tools() == []

@@ -353,11 +353,43 @@ def check_concretization(ops_dir=OPS_DIR):
 
 
 # ---------------------------------------------------------------------------
+# check 3: sibling lint tools (each exposes self_check() -> [violations])
+# ---------------------------------------------------------------------------
+
+# Cross-check registry: domain lints that ride along with the framework
+# gate. Each module lives in tools/, exposes `self_check()` returning a
+# list of violation strings, and `main(argv)` for standalone use.
+TOOL_CROSS_CHECKS = ["spmd_lint"]
+
+
+def check_registered_tools():
+    problems = []
+    tools_dir = os.path.dirname(os.path.abspath(__file__))
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    for mod_name in TOOL_CROSS_CHECKS:
+        try:
+            import importlib
+            mod = importlib.import_module(mod_name)
+        except Exception as e:
+            problems.append(f"cross-check tool '{mod_name}' failed to "
+                            f"import: {e!r}")
+            continue
+        if not callable(getattr(mod, "self_check", None)):
+            problems.append(f"cross-check tool '{mod_name}' has no "
+                            "self_check()")
+            continue
+        problems.extend(mod.self_check())
+    return problems
+
+
+# ---------------------------------------------------------------------------
 
 def run_lint(spec_path=SPEC_PATH, versions_path=VERSIONS_PATH,
              ops_dir=OPS_DIR):
     problems = check_registry_spec(spec_path, versions_path)
     problems += check_concretization(ops_dir)
+    problems += check_registered_tools()
     return problems
 
 

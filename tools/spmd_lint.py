@@ -1,0 +1,158 @@
+"""SPMD sharding lint — CLI front-end for static/spmd_analyzer.py.
+
+Builds the GPT tensor-parallel workload (BASELINE config-5 territory) as
+a static Program under an ABSTRACT mesh ({axis: size} — no TPUs or
+spoofed devices needed, so a pod layout lints from any dev box), derives
+PartitionSpecs from the sharding-rule name patterns, and prints the
+analyzer's report: the implied collective table, bytes/step, the
+per-device HBM estimate vs the replicated baseline, the pipeline-wire
+cost when --pp is given, and every diagnostic. Exit 1 on findings.
+
+  python tools/spmd_lint.py                    # tiny GPT, tp=2: clean
+  python tools/spmd_lint.py --tp 4 --layers 12 --hidden 768 --heads 12
+  python tools/spmd_lint.py --inject unbound-axis   # demo a finding
+
+tests/test_spmd_lint.py runs `self_check()` in tier-1 (the
+framework_lint.py cross-check list also pulls it in), so a propagation
+rule that stops resolving the TP golden path breaks the build.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+INJECTIONS = ("unbound-axis", "non-divisible", "duplicate-axis",
+              "spec-rank")
+
+
+def build_report(tp=2, dp=1, layers=2, hidden=64, heads=2, vocab=1024,
+                 batch=2, seq=16, inject=None):
+    """Trace the GPT forward statically and analyze it. Returns
+    (report, program, logits_var)."""
+    import paddle_tpu as paddle
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu import static
+    from paddle_tpu.distributed import sharding
+    from paddle_tpu.static import spmd_analyzer as spmd
+    from paddle_tpu.text.models.gpt import GPT, GPTConfig
+
+    was_static = static.in_static_mode()
+    paddle.enable_static()
+    try:
+        main = static.Program("spmd_lint_gpt")
+        with static.program_guard(main):
+            ids = static.data("input_ids", [batch, seq], "int64")
+            net = GPT(GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                                num_layers=layers, num_heads=heads,
+                                intermediate_size=4 * hidden,
+                                max_seq_len=max(seq, 8)))
+            logits = net(ids)
+        main._jit_fetch_vars = [logits]
+
+        mesh = {}
+        if dp > 1:
+            mesh["dp"] = dp
+        if tp > 1:
+            mesh["tp"] = tp
+        specs = sharding.named_param_specs(net, mesh)
+        if inject:
+            # demo/self-test seams: corrupt ONE spec the named way
+            name = next(n for n in specs
+                        if n == net.wte.weight.scope_name)
+            specs[name] = {
+                "unbound-axis": P("mp", None),
+                "duplicate-axis": P("tp", "tp"),
+                "non-divisible": None,  # handled below via odd vocab
+                "spec-rank": P("tp", None, "tp"),
+            }[inject]
+            if inject == "non-divisible":
+                # a vocab the tp axis cannot divide
+                import jax
+                pv = main.persistable_vars[name]
+                pv.aval = jax.ShapeDtypeStruct(
+                    (pv.aval.shape[0] + 1, pv.aval.shape[1]),
+                    pv.aval.dtype)
+                specs[name] = P("tp", None)
+        data_specs = {"input_ids": P("dp")} if dp > 1 else None
+        report = spmd.analyze_program(main, mesh=mesh, param_specs=specs,
+                                      data_specs=data_specs)
+        return report, main, logits
+    finally:
+        if not was_static:
+            paddle.disable_static()
+
+
+def self_check():
+    """Violation strings for framework_lint's cross-check registry: the
+    golden TP config must resolve with zero diagnostics and exactly the
+    expected collective set (one all-reduce per row-parallel projection
+    plus the vocab-parallel embedding gather)."""
+    layers = 2
+    try:
+        report, _, logits = build_report(tp=2, layers=layers)
+    except Exception as e:  # noqa: BLE001 - a lint must not crash the gate
+        return [f"spmd_lint self-check failed to build/analyze: {e!r}"]
+    problems = [f"spmd_lint golden TP config: {d}"
+                for d in report.diagnostics]
+    ar = [c for c in report.collectives if c.kind == "all_reduce"]
+    want = 2 * layers + 1
+    if len(ar) != want:
+        problems.append(
+            f"spmd_lint golden TP config: expected {want} all-reduces "
+            f"(2/block + vocab-parallel embedding), analyzer found "
+            f"{len(ar)}")
+    if any(c.axis != "tp" for c in ar):
+        problems.append("spmd_lint golden TP config: a collective left "
+                        "the tp axis")
+    if report.spec_of(logits)[-1] != ("tp",):
+        problems.append("spmd_lint golden TP config: logits lost the "
+                        "vocab (column-parallel) sharding")
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="static SPMD sharding lint (collectives, per-device "
+                    "HBM, diagnostics) for the GPT TP workload")
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1,
+                    help="report the pipeline ppermute wire cost for this "
+                    "many stages (schedule accounting only)")
+    ap.add_argument("--micro", type=int, default=8,
+                    help="pipeline microbatches (with --pp)")
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=16)
+    ap.add_argument("--inject", choices=INJECTIONS, default=None,
+                    help="corrupt one spec to demo the named diagnostic")
+    args = ap.parse_args(argv)
+
+    report, _, _ = build_report(
+        tp=args.tp, dp=args.dp, layers=args.layers, hidden=args.hidden,
+        heads=args.heads, vocab=args.vocab, batch=args.batch,
+        seq=args.seq, inject=args.inject)
+    report.publish()
+    print(report.render())
+    if args.pp > 1:
+        from paddle_tpu.distributed.pipeline import schedule_collectives
+        import numpy as np
+        hidden_bytes = (args.batch // max(args.dp, 1)) * args.seq \
+            * args.hidden * np.dtype("float32").itemsize // max(args.micro, 1)
+        pc = schedule_collectives(args.micro, args.pp, hidden_bytes)
+        print(f"pipeline wire cost ({args.pp} stages, {args.micro} "
+              f"microbatches): {pc['count']} ppermute ticks x "
+              f"{pc['bytes_per_tick']} B = {pc['total_bytes']} B/step "
+              "(forward)")
+    return 1 if report.diagnostics else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
