@@ -104,6 +104,39 @@ define_flag("FLAGS_use_fused_ce", True,
 define_flag("FLAGS_pallas_interpret", False,
             "run all Pallas kernels off-TPU via the interpreter (slow; "
             "for tests)")
+define_flag("FLAGS_use_decode_attention", True,
+            "route StaticKVCache incremental-decode attention through the "
+            "Pallas single-query flash kernel "
+            "(paddle_tpu.ops.pallas.decode_attention): cache-length "
+            "masking in-kernel, fully-masked KV blocks skipped via the "
+            "grid instead of streaming the whole max_seq_len cache")
+define_flag("FLAGS_decode_block_k", 0,
+            "decode-attention KV block size (0 = auto: autotune table or "
+            "the 128-column heuristic). Smaller blocks skip more of a "
+            "mostly-empty cache; larger blocks amortize grid overhead")
+define_flag("FLAGS_pallas_autotune", True,
+            "block-size autotuning for Pallas kernels: measure candidate "
+            "block configs at each new (kernel, shape-bucket, dtype, "
+            "backend) key and cache the winner (in-process; on disk too "
+            "when PADDLE_TPU_PALLAS_AUTOTUNE_CACHE names a json file). "
+            "Off-TPU the heuristic defaults are used instead — interpret "
+            "timings are meaningless. FLAGS_flash_block_* / "
+            "FLAGS_fused_ce_block_* / FLAGS_decode_block_k overrides "
+            "always win over the table")
+define_flag("FLAGS_pallas_autotune_force", False,
+            "measure autotune candidates even off-TPU (tests exercise the "
+            "measuring path in interpreter mode; never useful in prod)")
+define_flag("FLAGS_pallas_force_compile", False,
+            "force compiled (Mosaic) lowering of Pallas kernels even "
+            "off-TPU: tools/hlo_evidence.py uses this to AOT-lower bench "
+            "graphs for a TPU target on a dev box. Such programs lower "
+            "and cost-analyze fine but only *run* on real TPU hardware")
+define_flag("FLAGS_pallas_strict", False,
+            "re-raise Pallas kernel failures instead of demoting to the "
+            "jnp fallback (kernel development; the default False keeps a "
+            "kernel crash from ever aborting a training/bench run — each "
+            "demotion bumps pallas.fallback.{kernel}.{reason} in "
+            "core/monitor)")
 
 # --- PS transport fault tolerance (distributed/ps/rpc.py) ---------------
 # The reference's brpc channel exposes the same three knobs

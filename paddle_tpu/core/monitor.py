@@ -53,9 +53,14 @@ def stats(prefix: str = None) -> dict:
         return {k: v for k, v in _stats.items() if k.startswith(prefix)}
 
 
-def reset(name: str = None):
+def reset(name: str = None, prefix: str = None):
+    """Drop one counter, every counter under a prefix (e.g.
+    reset(prefix="pallas.") between bench modes), or everything."""
     with _lock:
-        if name is None:
+        if prefix is not None:
+            for k in [k for k in _stats if k.startswith(prefix)]:
+                del _stats[k]
+        elif name is None:
             _stats.clear()
         else:
             _stats.pop(name, None)

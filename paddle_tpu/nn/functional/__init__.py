@@ -103,38 +103,44 @@ def _flash_sdpa(q, k, v, mask, scale, is_causal):
 
 def _pallas_backend_ok(extra_flag=None):
     """Pallas kernels run compiled on TPU; elsewhere only when an interpret
-    flag opts in (tests)."""
+    flag opts in (tests) or FLAGS_pallas_force_compile is on (AOT TPU
+    lowering on a dev box — tools/hlo_evidence.py)."""
     import jax
     from ...core import flags as _flags
     if jax.default_backend() == "tpu":
         return True
     if _flags.flag("FLAGS_pallas_interpret"):
         return True
+    if _flags.flag("FLAGS_pallas_force_compile"):
+        return True
     return extra_flag is not None and _flags.flag(extra_flag)
 
 
 def _flash_eligible(query, key, value, attn_mask):
     from ...core import flags as _flags
+    from ...ops.pallas import gate_reject
     if not _flags.flag("FLAGS_use_flash_attention"):
-        return False
+        return gate_reject("flash_attention", "flag_off")
     if not _pallas_backend_ok("FLAGS_flash_attention_interpret"):
-        return False
+        return gate_reject("flash_attention", "backend")
     # profitability dispatch (measured on v5e): at short seq XLA's fused
     # attention wins — per-grid-step overhead dominates the kernel; the
     # kernel's O(s) memory + blockwise matmuls win in the long-context
     # regime. FLAGS_flash_min_seq=0 forces the kernel on.
     min_seq = int(_flags.flag("FLAGS_flash_min_seq"))
     if min_seq and key.shape[-2] < min_seq:
-        return False
+        return gate_reject("flash_attention", "min_seq")
     if attn_mask is not None and isinstance(attn_mask, Tensor) \
             and not attn_mask.stop_gradient:
         # the kernel treats the bias as data (no mask gradient); a learned
         # additive mask must take the jnp path, which differentiates it
-        return False
+        return gate_reject("flash_attention", "mask_grad")
     from ...ops.pallas.flash_attention import supported
     mask_shape = None if attn_mask is None else tuple(attn_mask.shape)
-    return supported(tuple(query.shape), tuple(key.shape),
-                     tuple(value.shape), mask_shape)
+    if not supported(tuple(query.shape), tuple(key.shape),
+                     tuple(value.shape), mask_shape):
+        return gate_reject("flash_attention", "shape")
+    return True
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
@@ -143,11 +149,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     """Fused attention core. On TPU this routes through the Pallas
     flash-attention kernel (paddle_tpu.ops.pallas.flash_attention): O(s)
     attention memory, blockwise online softmax on the MXU. The jnp fallback
-    (_sdpa) covers general mask shapes and non-TPU backends, where XLA
-    fuses the softmax chain."""
+    (_sdpa) covers general mask shapes, non-TPU backends (where XLA fuses
+    the softmax chain), and any kernel failure — run_guarded demotes a
+    crashed kernel to _sdpa instead of aborting the step."""
     sc = scale if scale is not None else query.shape[-1] ** -0.5
     if _flash_eligible(query, key, value, attn_mask):
-        out = _flash_sdpa(query, key, value, attn_mask, sc, is_causal)
+        from ...ops.pallas import run_guarded
+        out = run_guarded(
+            "flash_attention",
+            lambda: _flash_sdpa(query, key, value, attn_mask, sc, is_causal),
+            lambda: _sdpa(query, key, value, attn_mask, sc, is_causal))
     else:
         out = _sdpa(query, key, value, attn_mask, sc, is_causal)
     if dropout_p > 0.0 and training:
@@ -186,15 +197,27 @@ def fused_linear_cross_entropy(hidden, weight, bias=None, labels=None,
     loss head, fused.
     """
     from ...core import flags as _flags
+    from ...ops.pallas import gate_reject, run_guarded
     h2 = ops.reshape(hidden, [-1, hidden.shape[-1]])
     y = ops.reshape(labels, [-1])
     n, hd = h2.shape[0], h2.shape[1]
     from ...ops.pallas.fused_ce import supported
-    use_kernel = (_flags.flag("FLAGS_use_fused_ce")
-                  and _pallas_backend_ok()
-                  and supported(n, hd, weight.shape[0]))
-    op = _fused_ce_op if use_kernel else _ce_head_fallback
-    losses = op(h2, weight, bias, y, int(ignore_index))
+    if not _flags.flag("FLAGS_use_fused_ce"):
+        use_kernel = gate_reject("fused_ce", "flag_off")
+    elif not _pallas_backend_ok():
+        use_kernel = gate_reject("fused_ce", "backend")
+    elif not supported(n, hd, weight.shape[0]):
+        use_kernel = gate_reject("fused_ce", "shape")
+    else:
+        use_kernel = True
+    if use_kernel:
+        losses = run_guarded(
+            "fused_ce",
+            lambda: _fused_ce_op(h2, weight, bias, y, int(ignore_index)),
+            lambda: _ce_head_fallback(h2, weight, bias, y,
+                                      int(ignore_index)))
+    else:
+        losses = _ce_head_fallback(h2, weight, bias, y, int(ignore_index))
     if reduction == "none":
         return losses
     total = ops.sum(losses)

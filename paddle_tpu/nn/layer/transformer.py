@@ -69,6 +69,29 @@ def _static_cache_attention(q, kc, vc, index, scale, dropout_p, training):
     return jnp.einsum("bhsl,bhld->bhsd", p, vc)
 
 
+def _decode_kernel_eligible(q, kc, training):
+    """Gate for the Pallas decode-attention kernel on the StaticKVCache
+    path (ops/pallas/decode_attention.py). Every rejection is counted as
+    pallas.gate_reject.decode_attention.{reason} so bench output can say
+    why the cache path ran on jnp."""
+    from ...core import flags as _flags
+    from ...ops.pallas import gate_reject
+    if not _flags.flag("FLAGS_use_decode_attention"):
+        return gate_reject("decode_attention", "flag_off")
+    from .. import functional as F
+    if not F._pallas_backend_ok():
+        return gate_reject("decode_attention", "backend")
+    if training:
+        # the kernel is eval-only (no dropout, no vjp — differentiating
+        # the pallas_call would fail); training-time cache attention
+        # stays on the jnp path even at dropout=0
+        return gate_reject("decode_attention", "training")
+    from ...ops.pallas.decode_attention import supported
+    if not supported(tuple(q.shape), tuple(kc.shape)):
+        return gate_reject("decode_attention", "shape")
+    return True
+
+
 def _convert_attention_mask(attn_mask, dtype):
     if attn_mask is None:
         return None
@@ -143,9 +166,19 @@ class MultiHeadAttention(Layer):
                                               (zero, zero, idx, zero))
             vc = jax.lax.dynamic_update_slice(cache.v, vj,
                                               (zero, zero, idx, zero))
-            out = _static_cache_attention(
-                q._value, kc, vc, idx, self.head_dim ** -0.5,
-                self.dropout, self.training)
+            qv = q._value
+            scale = self.head_dim ** -0.5
+            if _decode_kernel_eligible(qv, kc, self.training):
+                from ...ops.pallas import decode_attention, run_guarded
+                out = run_guarded(
+                    "decode_attention",
+                    lambda: decode_attention(qv, kc, vc, idx, scale),
+                    lambda: _static_cache_attention(
+                        qv, kc, vc, idx, scale, self.dropout,
+                        self.training))
+            else:
+                out = _static_cache_attention(
+                    qv, kc, vc, idx, scale, self.dropout, self.training)
             from ...core.tensor import Tensor
             out = ops.transpose(Tensor(out, _internal=True), [0, 2, 1, 3])
             b, s = out.shape[0], out.shape[1]

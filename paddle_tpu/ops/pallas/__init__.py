@@ -5,13 +5,62 @@ operators/math/ (~30k LoC of CPU/CUDA primitives) and operators/jit/
 (runtime x86 codegen, reference jit/gen/jitcode.h:23). Where the reference
 drops to CUDA/xbyak for the ops XLA-era compilers couldn't fuse, we drop to
 Pallas for the ops XLA *still* can't schedule optimally: flash attention
-(O(s) memory online-softmax attention) is the first; kernels here own their
-backward passes via jax.custom_vjp (the analog of hand-written *_grad
-kernels).
+(O(s) memory online-softmax attention), the fused linear+CE loss head, and
+the single-query decode-attention kernel over StaticKVCache; train-path
+kernels own their backward passes via jax.custom_vjp (the analog of
+hand-written *_grad kernels).
 
 Kernels run compiled on TPU and in Pallas interpreter mode elsewhere, so the
 same code paths are testable on the CPU mesh (tests/conftest.py).
-"""
-from .flash_attention import flash_attention  # noqa: F401
 
-__all__ = ["flash_attention"]
+Every dispatch site goes through `run_guarded`: a kernel that fails to
+trace/compile/run demotes to its jnp fallback and bumps
+`pallas.fallback.{kernel}.{reason}` in core/monitor instead of aborting the
+step — a Mosaic crash must never poison a bench or training run (the
+BENCH_r03 failure mode, where both kernels crashed out and the whole run
+silently measured the fallback paths). Eligibility-gate rejections bump
+`pallas.gate_reject.{kernel}.{reason}` so bench output can report *why* a
+kernel didn't engage; engagements bump `pallas.hit.{kernel}`. The counters
+count call-site engagements (once per trace under jit), not per-step
+executions.
+"""
+from __future__ import annotations
+
+import warnings
+
+from .flash_attention import flash_attention  # noqa: F401
+from .decode_attention import decode_attention  # noqa: F401
+
+__all__ = ["flash_attention", "decode_attention", "run_guarded",
+           "gate_reject"]
+
+
+def gate_reject(kernel: str, reason: str):
+    """Record one eligibility-gate rejection (and return False so gates
+    can `return gate_reject(k, r)`)."""
+    from ...core import monitor
+    monitor.stat_add(f"pallas.gate_reject.{kernel}.{reason}")
+    return False
+
+
+def run_guarded(kernel: str, thunk, fallback):
+    """Run a Pallas kernel thunk; on ANY failure demote to the jnp
+    fallback thunk, bumping pallas.fallback.{kernel}.{exception-type}.
+    FLAGS_pallas_strict re-raises instead (kernel development / tests
+    that assert on the error itself)."""
+    from ...core import flags as _flags
+    from ...core import monitor
+    try:
+        out = thunk()
+    except Exception as e:
+        if _flags.flag("FLAGS_pallas_strict"):
+            raise
+        monitor.stat_add(f"pallas.fallback.{kernel}.{type(e).__name__}")
+        warnings.warn(
+            f"Pallas kernel '{kernel}' failed ({type(e).__name__}: {e}); "
+            "demoted to the jnp fallback for this call. See "
+            "monitor.stats('pallas.') and docs/pallas_kernels.md.",
+            RuntimeWarning, stacklevel=2)
+        return fallback()
+    monitor.stat_add(f"pallas.hit.{kernel}")
+    return out

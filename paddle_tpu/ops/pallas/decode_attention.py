@@ -1,0 +1,230 @@
+"""Single-query flash attention over a StaticKVCache — the decode kernel.
+
+The generate() hot loop attends one new token (or a small chunk) against a
+preallocated [b, h, max_seq_len, d] cache that is mostly empty: after
+prefilling a 32-token prompt into a 1024-slot cache, the jnp path
+(nn/layer/transformer._static_cache_attention) still streams all 1024
+padded K/V columns through the MXU every step and masks 90%+ of them to
+-1e9 after the fact. This kernel moves both the masking and the skipping
+inside the Pallas grid:
+
+- the cache length rides in as a *scalar-prefetch* operand (SMEM), so the
+  K/V BlockSpec index maps can clamp the block index to the last live
+  block — Pallas skips the HBM->VMEM DMA for a revisited block, so a step
+  at cache length `len` reads ~ceil(len/bk) blocks instead of
+  max_seq_len/bk;
+- fully-dead blocks skip their compute via pl.when on the same predicate;
+- the live/dead boundary column is masked in-kernel against
+  `index + row` (identical semantics to _static_cache_attention: position
+  p = index + row attends to cache cols <= p).
+
+Lengths may be a scalar (the StaticKVCache.index fast path) or a [b]
+vector — ragged per-batch lengths attend each batch row to its own
+prefix, which the jnp path can't express without materializing a mask.
+
+Decode runs under no_grad inside the generation scan, so this kernel is
+deliberately vjp-free: differentiating it raises, and the eligibility
+gate (nn/layer/transformer._decode_kernel_eligible) keeps training-time
+cache use on the jnp path.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .flash_attention import (NEG_INF, _ceil_to, _cparams, _interpret,
+                              _pick_block, _vmem)
+
+__all__ = ["decode_attention", "supported"]
+
+
+def _decode_attn_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                        m_scr, l_scr, acc_scr, *, scale, bk, nk, s):
+    """Grid (b, h, nk); nk is the sequential accumulator dim. len_ref is
+    the scalar-prefetch [b] live-length vector (index + s per batch)."""
+    ib, ik = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # np.int32 scalars throughout: arithmetic mixing an SMEM-read scalar
+    # with weak python ints emits scalar converts Mosaic can't lower
+    length = len_ref[ib]                       # live cols for the LAST row
+    index = length - np.int32(s)               # cache fill before the chunk
+    last = jnp.maximum(length - np.int32(1),
+                       np.int32(0)) // np.int32(bk)  # last live block
+
+    @pl.when(ik <= last)
+    def _compute():
+        q = q_ref[0, 0]                        # [s, d]
+        k = k_ref[0, 0]                        # [bk, d]
+        sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        row = jax.lax.broadcasted_iota(jnp.int32, (s, bk), 0)
+        col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (s, bk), 1)
+        # np.float32: weak-f64 scalar converts recurse Mosaic lowering on
+        # some jax builds (see flash_attention._causal_mask)
+        sc = jnp.where(col <= index + row, sc, np.float32(NEG_INF))
+        m_prev = m_scr[:]                      # [s, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(sc - m_new)                # [s, bk] f32
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0, 0],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _flush():
+        denom = jnp.maximum(l_scr[:], 1e-30)   # padded rows stay finite
+        o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def supported(q_shape, cache_shape) -> bool:
+    """Static predicate: can the decode kernel serve this (q, cache) pair?
+    q [b, h, s, d] against cache [b, h, L, d]. The query chunk is padded
+    to the 8-row sublane tile in the wrapper, so any s up to 256 works;
+    beyond that a chunked prefill belongs on the flash kernel instead."""
+    if len(q_shape) != 4 or len(cache_shape) != 4:
+        return False
+    b, h, s, d = q_shape
+    bl, hl, L, dl = cache_shape
+    if (bl, hl, dl) != (b, h, d):
+        return False
+    if d > 256 or s < 1 or s > 256 or L < 8:
+        return False
+    return _pick_block(_ceil_to(L, 8), 128) is not None
+
+
+def _call(q, kc, vc, lengths, scale, bk):
+    """The pallas_call for already-tile-padded operands."""
+    from jax.experimental.pallas import tpu as pltpu
+    b, h, s_p, d = q.shape
+    nk = kc.shape[2] // bk
+
+    def q_map(ib, ih, ik, len_ref):
+        return (ib, ih, 0, 0)
+
+    def kv_map(ib, ih, ik, len_ref):
+        # clamp to the last live block: a revisited block index skips the
+        # HBM->VMEM DMA, so dead cache tail blocks are never fetched
+        # (np.int32 scalars: see _decode_attn_kernel)
+        last = jnp.maximum(len_ref[ib] - np.int32(1),
+                           np.int32(0)) // np.int32(bk)
+        return (ib, ih, jnp.minimum(ik, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, s_p, d), q_map),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, s_p, d), q_map),
+        scratch_shapes=[
+            _vmem((s_p, 1), jnp.float32),
+            _vmem((s_p, 1), jnp.float32),
+            _vmem((s_p, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_decode_attn_kernel, scale=float(scale),
+                               bk=bk, nk=nk, s=s_p)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s_p, d), q.dtype),
+        compiler_params=_cparams("parallel", "parallel", "arbitrary"),
+        interpret=_interpret(),
+    )(lengths, q, kc, vc)
+
+
+def _pick_bk(shape, dtype, scale, measure_builder):
+    """KV block size: FLAGS_decode_block_k override, else the autotune
+    table, else 128 columns (one MXU lane tile; small enough that a
+    33-token prompt reads one block, big enough to amortize the grid)."""
+    from ...core import flags as _flags
+    from . import autotune
+    b, h, s_p, d, L_p = shape
+    cfg = int(_flags.flag("FLAGS_decode_block_k") or 0)
+    default = _pick_block(L_p, cfg or 128)
+    if cfg:
+        return default
+    cands = [(x,) for x in (256, 128, 64) if L_p % x == 0]
+    if len(cands) <= 1:
+        return default
+    return autotune.lookup(
+        "decode_attention",
+        (autotune.bucket(L_p), autotune.bucket(s_p), d),
+        dtype, cands, measure_builder(), (default,))[0]
+
+
+def decode_attention(q, kc, vc, index, scale=None, block_k=None):
+    """Attention of q [b, h, s, d] over a partially-filled cache
+    kc/vc [b, h, L, d]. `index` is the cache fill count before this chunk
+    — an i32 scalar (StaticKVCache.index) or a [b] vector for ragged
+    per-batch fills. Row r of the chunk attends to cache cols
+    <= index + r. Returns [b, h, s, d] in q's dtype. Eval-only (no vjp).
+    """
+    b, h, s, d = q.shape
+    L = kc.shape[2]
+    if vc.shape != kc.shape or kc.shape[3] != d:
+        raise ValueError(f"decode_attention: cache shapes k{tuple(kc.shape)}"
+                         f" v{tuple(vc.shape)} don't match q{tuple(q.shape)}")
+    if scale is None:
+        scale = d ** -0.5
+    out_dtype = q.dtype
+    if q.dtype != kc.dtype:
+        q = q.astype(kc.dtype)  # keep both matmuls on one MXU dtype
+
+    s_p = _ceil_to(s, 8)   # sublane tile: pad query rows, slice back below
+    if s_p != s:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, s_p - s), (0, 0)))
+    # lengths are in PADDED-row terms (the kernel recovers the fill count
+    # as length - s_p); padded rows attend a few cols past the live end —
+    # they are garbage rows sliced off below
+    lengths = jnp.asarray(index, jnp.int32)
+    lengths = jnp.broadcast_to(lengths.reshape(-1), (b,)) + jnp.int32(s_p)
+    L_p = _ceil_to(L, 8)
+    if L_p != L:
+        # ragged caches only appear in tests; padded cols are dead because
+        # lengths <= L never reaches them
+        kc = jnp.pad(kc, ((0, 0), (0, 0), (0, L_p - L), (0, 0)))
+        vc = jnp.pad(vc, ((0, 0), (0, 0), (0, L_p - L), (0, 0)))
+
+    def measure_builder():
+        def measure(params):
+            from . import autotune
+            (bk_,) = params
+            # measure at full cache length — the worst case every long
+            # generation converges to; synthetic zeros (tracer-safe)
+            qz = jnp.zeros(q.shape, q.dtype)
+            kz = jnp.zeros(kc.shape, kc.dtype)
+            lz = jnp.full((b,), L_p, jnp.int32)
+            fn = jax.jit(lambda a, k_, v_, ln: _call(a, k_, v_, ln,
+                                                     float(scale), bk_))
+            return autotune.time_thunk(lambda: fn(qz, kz, kz, lz))
+        return measure
+
+    if block_k:
+        bk = int(block_k)
+        if L_p % bk != 0:
+            # a non-divisor would floor-truncate the grid and silently
+            # drop tail cache blocks from attention
+            raise ValueError(f"decode_attention: block_k={bk} does not "
+                             f"divide the padded cache length {L_p}")
+    else:
+        bk = _pick_bk((b, h, s_p, d, L_p), str(q.dtype), scale,
+                      measure_builder)
+    out = _call(q, kc, vc, lengths, scale, bk)
+    out = out.astype(out_dtype)
+    return out[:, :, :s] if s_p != s else out

@@ -20,9 +20,11 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
-from .flash_attention import NEG_INF, _Z, _cparams, _interpret, _vmem
+from .flash_attention import NEG_INF, _Z, _ceil_to, _cparams, _interpret, \
+    _vmem
 
 
 def _pick(n, target):
@@ -36,8 +38,8 @@ def _pick(n, target):
 # forward: loss[i] = lse_i - logit_i[y_i]   (0 where y_i == ignore_index)
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(h_ref, w_ref, b_ref, y_ref, loss_ref, lse_ref,
-                m_scr, l_scr, t_scr, *, bn, bv, nv, vocab, ignore):
+def _ce_fwd_kernel(h_ref, w_ref, b_ref, y_ref, loss_ref, lse_ref,
+                   m_scr, l_scr, t_scr, *, bn, bv, nv, vocab, ignore):
     iv = pl.program_id(1)
 
     @pl.when(iv == 0)
@@ -53,10 +55,13 @@ def _fwd_kernel(h_ref, w_ref, b_ref, y_ref, loss_ref, lse_ref,
     if b_ref is not None:
         s = s + b_ref[:]                           # [1, bv]
     col = iv * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
-    s = jnp.where(col < vocab, s, NEG_INF)         # ragged last vocab tile
+    # np.float32 scalars in kernel jnp.where: weak-f64 scalar converts
+    # recurse Mosaic lowering on some jax builds (see _causal_mask)
+    s = jnp.where(col < vocab, s, np.float32(NEG_INF))  # ragged vocab tile
 
     y = y_ref[:].reshape(bn, 1)                    # [bn, 1] int32
-    t_scr[:] += jnp.sum(jnp.where(col == y, s, 0.0), axis=-1, keepdims=True)
+    t_scr[:] += jnp.sum(jnp.where(col == y, s, np.float32(0.0)),
+                        axis=-1, keepdims=True)
 
     m_prev = m_scr[:]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -69,32 +74,37 @@ def _fwd_kernel(h_ref, w_ref, b_ref, y_ref, loss_ref, lse_ref,
         lse = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
         y2 = y_ref[:].reshape(bn, 1)
         valid = y2 != ignore
-        loss_ref[:] = jnp.where(valid, lse - t_scr[:], 0.0).reshape(
-            loss_ref.shape)
+        loss_ref[:] = jnp.where(valid, lse - t_scr[:],
+                                np.float32(0.0)).reshape(loss_ref.shape)
         lse_ref[:] = lse.reshape(lse_ref.shape)
 
 
-def _fwd(h, w, b, y, ignore, bn, bv):
+def _fwd(h, w, b, y, ignore, bn, bv, vocab):
+    """`vocab` is the LOGICAL vocab; w may carry tile-padding rows beyond
+    it (wrapper pads to a multiple of 128) which the col<vocab masks keep
+    out of the softmax."""
     n, hd = h.shape
-    vocab = w.shape[0]
-    nv = pl.cdiv(vocab, bv)
-    args = [h.reshape(1, n, hd), w.reshape(1, vocab, hd)]
+    v_rows = w.shape[0]
+    nv = pl.cdiv(v_rows, bv)
+    args = [h.reshape(1, n, hd), w.reshape(1, v_rows, hd)]
     in_specs = [
         pl.BlockSpec((1, bn, hd), lambda i, j: (_Z, i, _Z)),
         pl.BlockSpec((1, bv, hd), lambda i, j: (_Z, j, _Z)),
     ]
     if b is not None:
-        args.append(b.reshape(1, vocab))
+        args.append(b.reshape(1, v_rows))
         in_specs.append(pl.BlockSpec((1, bv), lambda i, j: (_Z, j)))
     args.append(y.reshape(1, n))
     in_specs.append(pl.BlockSpec((1, bn), lambda i, j: (_Z, i)))
 
     opts = dict(bn=bn, bv=bv, nv=nv, vocab=vocab, ignore=ignore)
     if b is not None:
-        kernel = functools.partial(_fwd_kernel, **opts)
+        kernel = functools.partial(_ce_fwd_kernel, **opts)
     else:
         def kernel(hr, wr, yr, lo, ls, m, l, t):  # noqa: E741
-            return _fwd_kernel(hr, wr, None, yr, lo, ls, m, l, t, **opts)
+            return _ce_fwd_kernel(hr, wr, None, yr, lo, ls, m, l, t, **opts)
+        # stamped into the lowered custom call; hlo_evidence greps for it
+        kernel.__name__ = _ce_fwd_kernel.__name__
 
     loss, lse = pl.pallas_call(
         kernel,
@@ -124,16 +134,16 @@ def _ds_tile(h, w, b_ref, y, lse, g, iv, bn, bv, vocab, ignore):
     if b_ref is not None:
         s = s + b_ref[:]
     col = iv * bv + jax.lax.broadcasted_iota(jnp.int32, (bn, bv), 1)
-    p = jnp.exp(jnp.where(col < vocab, s, NEG_INF) - lse)
+    p = jnp.exp(jnp.where(col < vocab, s, np.float32(NEG_INF)) - lse)
     # (col == y).astype, NOT jnp.where(col == y, 1.0, 0.0): scalar-scalar
     # where defaults to f64 under jax_enable_x64 and Mosaic aborts on any
     # 64-bit kernel value (layout.h bitwidth check)
     ds = p - (col == y).astype(jnp.float32)
-    return ds * jnp.where(y != ignore, g, 0.0)     # [bn, bv] f32
+    return ds * jnp.where(y != ignore, g, np.float32(0.0))  # [bn, bv] f32
 
 
-def _bwd_dh_kernel(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref, dh_ref,
-                   dh_scr, *, bn, bv, nv, vocab, ignore):
+def _ce_bwd_dh_kernel(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref, dh_ref,
+                      dh_scr, *, bn, bv, nv, vocab, ignore):
     iv = pl.program_id(1)
 
     @pl.when(iv == 0)
@@ -146,9 +156,11 @@ def _bwd_dh_kernel(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref, dh_ref,
     g = g_ref[:].reshape(bn, 1)
     ds = _ds_tile(h, w, b_ref, y, lse, g, iv, bn, bv, vocab, ignore)
     # zero the ragged tile's out-of-range w rows: they're uninitialized
-    # padding, and 0 * garbage in the contraction would poison dh
+    # padding, and 0 * garbage in the contraction would poison dh.
+    # The zero must be a strong scalar of w's dtype: a weak `0` promotes
+    # to a weak-f32 scalar whose convert loops Mosaic's lowering forever
     row = iv * bv + jax.lax.broadcasted_iota(jnp.int32, (bv, 1), 0)
-    wm = jnp.where(row < vocab, w, 0).astype(w.dtype)
+    wm = jnp.where(row < vocab, w, jnp.zeros((), w.dtype))
     dh_scr[:] += jax.lax.dot_general(ds.astype(w.dtype), wm,
                                      (((1,), (0,)), ((), ())),
                                      preferred_element_type=jnp.float32)
@@ -158,9 +170,9 @@ def _bwd_dh_kernel(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref, dh_ref,
         dh_ref[0] = dh_scr[:].astype(dh_ref.dtype)
 
 
-def _bwd_dw_kernel(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref,
-                   dw_ref, db_ref, dw_scr, db_scr,
-                   *, bn, bv, nn_, vocab, ignore, with_bias):
+def _ce_bwd_dw_kernel(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref,
+                      dw_ref, db_ref, dw_scr, db_scr,
+                      *, bn, bv, nn_, vocab, ignore, with_bias):
     iv, i_n = pl.program_id(1), pl.program_id(2)
 
     @pl.when(i_n == 0)
@@ -187,17 +199,17 @@ def _bwd_dw_kernel(h_ref, w_ref, b_ref, y_ref, lse_ref, g_ref,
             db_ref[:] = db_scr[:].astype(db_ref.dtype)
 
 
-def _bwd(h, w, b, y, lse, g, ignore, bn, bv):
+def _bwd(h, w, b, y, lse, g, ignore, bn, bv, vocab):
     n, hd = h.shape
-    vocab = w.shape[0]
-    nv = pl.cdiv(vocab, bv)
+    v_rows = w.shape[0]
+    nv = pl.cdiv(v_rows, bv)
     nn_ = n // bn
     h3 = h.reshape(1, n, hd)
-    w3 = w.reshape(1, vocab, hd)
+    w3 = w.reshape(1, v_rows, hd)
     y2 = y.reshape(1, n)
     lse2 = lse.reshape(1, n)
     g2 = g.astype(jnp.float32).reshape(1, n)
-    base_args = [h3, w3] + ([b.reshape(1, vocab)] if b is not None else []) \
+    base_args = [h3, w3] + ([b.reshape(1, v_rows)] if b is not None else []) \
         + [y2, lse2, g2]
 
     def base_specs(ij_h, ij_w, ij_b, ij_n):
@@ -211,10 +223,12 @@ def _bwd(h, w, b, y, lse, g, ignore, bn, bv):
     # ---- dh: grid (n/bn, nv), vocab tiles innermost ----------------------
     opts = dict(bn=bn, bv=bv, nv=nv, vocab=vocab, ignore=ignore)
     if b is not None:
-        dh_kernel = functools.partial(_bwd_dh_kernel, **opts)
+        dh_kernel = functools.partial(_ce_bwd_dh_kernel, **opts)
     else:
         def dh_kernel(hr, wr, yr, lr, gr, dhr, scr):
-            return _bwd_dh_kernel(hr, wr, None, yr, lr, gr, dhr, scr, **opts)
+            return _ce_bwd_dh_kernel(hr, wr, None, yr, lr, gr, dhr, scr,
+                                     **opts)
+        dh_kernel.__name__ = _ce_bwd_dh_kernel.__name__
 
     dh = pl.pallas_call(
         dh_kernel,
@@ -232,11 +246,12 @@ def _bwd(h, w, b, y, lse, g, ignore, bn, bv):
     wopts = dict(bn=bn, bv=bv, nn_=nn_, vocab=vocab, ignore=ignore,
                  with_bias=b is not None)
     if b is not None:
-        dw_kernel = functools.partial(_bwd_dw_kernel, **wopts)
+        dw_kernel = functools.partial(_ce_bwd_dw_kernel, **wopts)
     else:
         def dw_kernel(hr, wr, yr, lr, gr, dwr, dbr, ws, bs):
-            return _bwd_dw_kernel(hr, wr, None, yr, lr, gr, dwr, dbr,
-                                  ws, bs, **wopts)
+            return _ce_bwd_dw_kernel(hr, wr, None, yr, lr, gr, dwr, dbr,
+                                     ws, bs, **wopts)
+        dw_kernel.__name__ = _ce_bwd_dw_kernel.__name__
 
     dw, db = pl.pallas_call(
         dw_kernel,
@@ -246,15 +261,15 @@ def _bwd(h, w, b, y, lse, g, ignore, bn, bv):
             lambda z, j, i: (_Z, j), lambda z, j, i: (_Z, i)),
         out_specs=[pl.BlockSpec((1, bv, hd), lambda z, j, i: (_Z, j, _Z)),
                    pl.BlockSpec((1, bv), lambda z, j, i: (_Z, j))],
-        out_shape=[jax.ShapeDtypeStruct((1, vocab, hd), w.dtype),
-                   jax.ShapeDtypeStruct((1, vocab), jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct((1, v_rows, hd), w.dtype),
+                   jax.ShapeDtypeStruct((1, v_rows), jnp.float32)],
         scratch_shapes=[_vmem((bv, hd), jnp.float32),
                         _vmem((1, bv), jnp.float32)],
         compiler_params=_cparams("parallel", "parallel", "arbitrary"),
         interpret=_interpret(),
     )(*base_args)
-    dw = dw.reshape(vocab, hd)
-    db_out = None if b is None else db.reshape(vocab).astype(
+    dw = dw.reshape(v_rows, hd)
+    db_out = None if b is None else db.reshape(v_rows).astype(
         b.dtype if hasattr(b, "dtype") else jnp.float32)
     return dh, dw, db_out
 
@@ -263,20 +278,20 @@ def _bwd(h, w, b, y, lse, g, ignore, bn, bv):
 # public op
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _fused_ce(h, w, b, y, ignore, bn, bv):
-    loss, _ = _fwd(h, w, b, y, ignore, bn, bv)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _fused_ce(h, w, b, y, ignore, bn, bv, vocab):
+    loss, _ = _fwd(h, w, b, y, ignore, bn, bv, vocab)
     return loss
 
 
-def _fused_ce_fwd(h, w, b, y, ignore, bn, bv):
-    loss, lse = _fwd(h, w, b, y, ignore, bn, bv)
+def _fused_ce_fwd(h, w, b, y, ignore, bn, bv, vocab):
+    loss, lse = _fwd(h, w, b, y, ignore, bn, bv, vocab)
     return loss, (h, w, b, y, lse)
 
 
-def _fused_ce_bwd(ignore, bn, bv, res, g):
+def _fused_ce_bwd(ignore, bn, bv, vocab, res, g):
     h, w, b, y, lse = res
-    dh, dw, db = _bwd(h, w, b, y, lse, g, ignore, bn, bv)
+    dh, dw, db = _bwd(h, w, b, y, lse, g, ignore, bn, bv, vocab)
     return dh, dw, db, None
 
 
@@ -284,7 +299,33 @@ _fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
 
 
 def supported(n: int, hidden: int, vocab: int) -> bool:
-    return _pick(n, 512) is not None and hidden % 8 == 0 and vocab >= 8
+    """Any vocab size works — the wrapper pads the weight rows up to a
+    multiple of 128 (lane tile) and masks the padding out of the softmax,
+    so a 30522-row BERT head is as kernel-eligible as a 30720-row one."""
+    return _pick(n, 512) is not None and hidden % 8 == 0 and vocab >= 1
+
+
+def _pick_blocks(n, v_rows, hd, dtype, with_bias, measure_builder):
+    """(bn, bv) resolution: FLAGS_fused_ce_block_* overrides, then the
+    autotune table, then the static heuristic (512/512)."""
+    from ...core import flags as _flags
+    from . import autotune
+    bn_cfg = int(_flags.flag("FLAGS_fused_ce_block_n") or 0)
+    bv_cfg = int(_flags.flag("FLAGS_fused_ce_block_v") or 0)
+    bn_default = _pick(n, bn_cfg or 512)
+    bv_default = bv_cfg or next(x for x in (512, 256, 128)
+                                if v_rows % x == 0)
+    if bn_cfg or bv_cfg:
+        return bn_default, min(bv_default, v_rows)
+    cands = [(bn, bv)
+             for bn in (512, 256, 128) if n % bn == 0
+             for bv in (512, 256, 128) if v_rows % bv == 0]
+    if not cands:
+        return bn_default, min(bv_default, v_rows)
+    return autotune.lookup(
+        "fused_ce",
+        (autotune.bucket(n), autotune.bucket(v_rows), hd, int(with_bias)),
+        dtype, cands, measure_builder(), (bn_default, bv_default))
 
 
 def fused_linear_cross_entropy(hidden, weight, bias, labels,
@@ -295,17 +336,38 @@ def fused_linear_cross_entropy(hidden, weight, bias, labels,
     hidden: [n, H] (bf16/f32); weight: [vocab, H] (tied-embedding layout);
     bias: [vocab] or None; labels: [n] int. Returns f32 [n] losses, 0 where
     labels == ignore_index. Reduce (mean over valid) in the caller.
+
+    Non-tile-aligned vocab sizes are padded here (weight rows to a
+    multiple of 128, zeros) and masked in-kernel by the logical `vocab`;
+    padded dW/db rows come back ~0 and jnp.pad's vjp slices them off.
     """
     from ...core import flags as _flags
     n, hd = hidden.shape
     vocab = weight.shape[0]
     bn_target = int(_flags.flag("FLAGS_fused_ce_block_n") or 0) or 512
-    bn = _pick(n, bn_target)
-    if bn is None:
+    if _pick(n, bn_target) is None:
         raise ValueError(f"fused CE: n_tokens {n} has no block factor")
-    bv_cfg = int(_flags.flag("FLAGS_fused_ce_block_v") or 0)
-    bv = bv_cfg or (512 if vocab >= 512
-                    else max(8, 1 << (vocab - 1).bit_length() >> 1))
+    v_pad = _ceil_to(vocab, 128)
+    if v_pad != vocab:
+        weight = jnp.pad(weight, ((0, v_pad - vocab), (0, 0)))
+        if bias is not None:
+            bias = jnp.pad(bias, (0, v_pad - vocab))
     labels = labels.astype(jnp.int32)
+
+    def measure_builder():
+        def measure(params):
+            from . import autotune
+            bn_, bv_ = params
+            hz = jnp.zeros((n, hd), hidden.dtype)
+            wz = jnp.zeros((v_pad, hd), weight.dtype)
+            bz = None if bias is None else jnp.zeros((v_pad,), bias.dtype)
+            yz = jnp.zeros((n,), jnp.int32)
+            fn = jax.jit(lambda a, b_, c: _fused_ce(
+                a, b_, bz, c, int(ignore_index), bn_, bv_, vocab))
+            return autotune.time_thunk(lambda: fn(hz, wz, yz))
+        return measure
+
+    bn, bv = _pick_blocks(n, v_pad, hd, str(hidden.dtype),
+                          bias is not None, measure_builder)
     return _fused_ce(hidden, weight, bias, labels, int(ignore_index),
-                     bn, min(bv, vocab))
+                     bn, min(bv, v_pad), vocab)

@@ -1,0 +1,193 @@
+"""Pallas decode-attention kernel: parity with the jnp StaticKVCache path.
+
+Interpret-mode (FLAGS_pallas_interpret) parity tests vs
+_static_cache_attention / _sdpa — cache-length masking at several index
+values, ragged per-batch lengths, bf16/f32 tolerances, and the vjp-free
+eval contract (training-time cache attention stays on the jnp path).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor
+from paddle_tpu.nn.layer.transformer import _static_cache_attention
+from paddle_tpu.ops.pallas.decode_attention import decode_attention, supported
+
+
+@pytest.fixture
+def interpret():
+    paddle.set_flags({"FLAGS_pallas_interpret": True})
+    yield
+    paddle.set_flags({"FLAGS_pallas_interpret": False})
+
+
+def _ref_ragged(q, kc, vc, lengths, scale):
+    """Dense numpy oracle with per-batch live lengths (row r of batch i
+    attends to cache cols <= lengths[i] - s + r)."""
+    b, h, s, d = q.shape
+    L = kc.shape[2]
+    out = []
+    for i in range(b):
+        index = int(lengths[i]) - s
+        live = np.arange(L)[None, :] <= index + np.arange(s)[:, None]
+        sc = np.einsum("hsd,hld->hsl", np.asarray(q[i], np.float32),
+                       np.asarray(kc[i], np.float32)) * scale
+        sc = np.where(live[None], sc, -1e9)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        out.append(np.einsum("hsl,hld->hsd", p,
+                             np.asarray(vc[i], np.float32)))
+    return np.stack(out)
+
+
+@pytest.mark.parametrize("index,s", [(0, 8), (0, 1), (17, 1), (31, 1),
+                                     (96, 32), (127, 1)])
+def test_matches_static_cache_attention(interpret, index, s):
+    """Scalar cache index at several fill levels, incl. empty-cache
+    prefill (index=0) and a full cache (index + s == L)."""
+    rng = np.random.RandomState(0)
+    b, h, d, L = 2, 3, 16, 128
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    kc = jnp.asarray(rng.randn(b, h, L, d), jnp.float32)
+    vc = jnp.asarray(rng.randn(b, h, L, d), jnp.float32)
+    idx = jnp.int32(index)
+
+    out = decode_attention(q, kc, vc, idx)
+    ref = _static_cache_attention(q, kc, vc, idx, d ** -0.5, 0.0, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ragged_per_batch_lengths(interpret):
+    """A [b] index vector: each batch row attends its own prefix — the
+    jnp path can't express this without a materialized mask."""
+    rng = np.random.RandomState(1)
+    b, h, s, d, L = 4, 2, 1, 32, 256
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    kc = jnp.asarray(rng.randn(b, h, L, d), jnp.float32)
+    vc = jnp.asarray(rng.randn(b, h, L, d), jnp.float32)
+    index = jnp.asarray([0, 17, 130, 255], jnp.int32)
+
+    out = decode_attention(q, kc, vc, index)
+    ref = _ref_ragged(q, kc, vc, np.asarray(index) + s, d ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+def test_bf16_tolerance(interpret):
+    rng = np.random.RandomState(2)
+    b, h, s, d, L = 2, 2, 1, 32, 128
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.bfloat16)
+    kc = jnp.asarray(rng.randn(b, h, L, d), jnp.bfloat16)
+    vc = jnp.asarray(rng.randn(b, h, L, d), jnp.bfloat16)
+    idx = jnp.int32(40)
+    out = decode_attention(q, kc, vc, idx)
+    assert out.dtype == jnp.bfloat16
+    ref = _static_cache_attention(q.astype(jnp.float32),
+                                  kc.astype(jnp.float32),
+                                  vc.astype(jnp.float32), idx, d ** -0.5,
+                                  0.0, False)
+    np.testing.assert_allclose(np.asarray(out, np.float32), np.asarray(ref),
+                               atol=2e-2)
+
+
+def test_under_jit_traced_index(interpret):
+    """The generate() scan passes a traced index; the scalar-prefetch grid
+    must handle it (this is the whole point of the design)."""
+    rng = np.random.RandomState(3)
+    b, h, s, d, L = 2, 2, 1, 16, 64
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    kc = jnp.asarray(rng.randn(b, h, L, d), jnp.float32)
+    vc = jnp.asarray(rng.randn(b, h, L, d), jnp.float32)
+
+    fn = jax.jit(lambda q, kc, vc, i: decode_attention(q, kc, vc, i))
+    for index in (0, 13, 63):
+        out = fn(q, kc, vc, jnp.int32(index))
+        ref = _static_cache_attention(q, kc, vc, jnp.int32(index),
+                                      d ** -0.5, 0.0, False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+
+def test_block_k_override_and_flag(interpret):
+    rng = np.random.RandomState(4)
+    b, h, s, d, L = 1, 1, 1, 16, 256
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    kc = jnp.asarray(rng.randn(b, h, L, d), jnp.float32)
+    vc = jnp.asarray(rng.randn(b, h, L, d), jnp.float32)
+    ref = _static_cache_attention(q, kc, vc, jnp.int32(100), d ** -0.5,
+                                  0.0, False)
+    for bk in (64, 128, 256):
+        out = decode_attention(q, kc, vc, jnp.int32(100), block_k=bk)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+    paddle.set_flags({"FLAGS_decode_block_k": 64})
+    try:
+        out = decode_attention(q, kc, vc, jnp.int32(100))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+    finally:
+        paddle.set_flags({"FLAGS_decode_block_k": 0})
+
+
+def test_supported_gate():
+    assert supported((2, 4, 1, 64), (2, 4, 1024, 64))
+    assert supported((2, 4, 32, 64), (2, 4, 1024, 64))     # chunked prefill
+    assert not supported((2, 4, 1, 512), (2, 4, 1024, 512))  # head too wide
+    assert not supported((2, 4, 512, 64), (2, 4, 1024, 64))  # prefill, not
+    assert not supported((2, 4, 1, 64), (2, 2, 1024, 64))    # heads differ
+
+
+def test_mha_cache_path_uses_kernel_in_eval(interpret):
+    """MultiHeadAttention + StaticKVCache routes through the decode kernel
+    in eval mode (hit counter) and matches the jnp path bit-for-bit-ish;
+    training with dropout stays on jnp (gate counter)."""
+    from paddle_tpu import nn
+    paddle.seed(0)
+    mha = nn.MultiHeadAttention(32, 2, dropout=0.5)
+    mha.eval()
+    x = paddle.randn([2, 4, 32])
+    cache = mha.gen_static_cache(2, 16, "float32")
+
+    for name in list(monitor.stats("pallas.")):
+        monitor.reset(name)
+    out_k, _ = mha(x, cache=cache)
+    assert monitor.stat_get("pallas.hit.decode_attention") == 1
+
+    paddle.set_flags({"FLAGS_use_decode_attention": False})
+    try:
+        out_j, _ = mha(x, cache=cache)
+    finally:
+        paddle.set_flags({"FLAGS_use_decode_attention": True})
+    np.testing.assert_allclose(np.asarray(out_k._value),
+                               np.asarray(out_j._value), atol=2e-5)
+    assert monitor.stat_get(
+        "pallas.gate_reject.decode_attention.flag_off") == 1
+
+    # training mode: gate keeps the kernel out (vjp-free contract — even
+    # at dropout=0 the kernel must not end up in a differentiated graph)
+    mha.train()
+    _ = mha(x, cache=mha.gen_static_cache(2, 16, "float32"))
+    assert monitor.stat_get(
+        "pallas.gate_reject.decode_attention.training") == 1
+
+
+def test_gpt_generate_cached_kernel_matches_oracle(interpret):
+    """End to end: tiny-GPT generate(use_cache=True) with the decode
+    kernel equals the no-cache host-loop oracle (greedy)."""
+    from paddle_tpu.text.models.gpt import GPT, GPTConfig
+    paddle.seed(0)
+    net = GPT(GPTConfig.tiny())
+    net.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 1024, (2, 7)).astype("int64"))
+
+    for name in list(monitor.stats("pallas.")):
+        monitor.reset(name)
+    out_cached = net.generate(ids, max_new_tokens=9, temperature=0,
+                              use_cache=True)
+    assert monitor.stat_get("pallas.hit.decode_attention") > 0
+    out_oracle = net.generate(ids, max_new_tokens=9, temperature=0,
+                              use_cache=False)
+    np.testing.assert_array_equal(np.asarray(out_cached._value),
+                                  np.asarray(out_oracle._value))

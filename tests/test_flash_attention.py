@@ -134,9 +134,10 @@ def test_flash_fallbacks():
                          (2, 1, 1, 1))
     # v head_dim differs from q/k
     assert not supported((1, 2, 32, 16), (1, 2, 32, 16), (1, 2, 32, 32))
-    # odd sequence length: no block factor
-    assert not supported((1, 2, 33, 16), (1, 2, 33, 16), (1, 2, 33, 16))
-    # the functional API still works on those shapes (fallback path)
+    # odd sequence lengths ARE supported now: the wrapper pads to a
+    # multiple of 8 (masking padded key columns) and slices back
+    assert supported((1, 2, 33, 16), (1, 2, 33, 16), (1, 2, 33, 16))
+    # the functional API works on odd shapes through the kernel
     paddle.set_flags({"FLAGS_flash_attention_interpret": True,
                           "FLAGS_flash_min_seq": 0})
     try:
@@ -149,6 +150,44 @@ def test_flash_fallbacks():
         assert tuple(out.shape) == (1, 2, 33, 16)
     finally:
         paddle.set_flags({"FLAGS_flash_attention_interpret": False})
+
+
+@pytest.mark.parametrize("sq,sk,causal,with_bias", [
+    (33, 33, False, False),   # odd square
+    (33, 33, True, False),    # odd causal: original diagonal preserved
+    (7, 65, False, True),     # both dims ragged + bias path
+    (1, 40, True, False),     # single-row decode-like query
+])
+def test_flash_padded_odd_shapes_match_reference(sq, sk, causal, with_bias):
+    """Pad-to-8 + bias masking + slice-back must be exact vs the dense
+    reference, forward and backward."""
+    from paddle_tpu.nn.functional import _sdpa
+    rng = np.random.RandomState(7)
+    b, h, d = 2, 2, 16
+    q = jnp.asarray(rng.randn(b, h, sq, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, sk, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, sk, d), jnp.float32)
+    bias = jnp.asarray(np.where(rng.rand(b, sk) < 0.3, -1e9, 0.0),
+                       jnp.float32) if with_bias else None
+
+    out = flash_attention(q, k, v, bias=bias, causal=causal)
+    assert out.shape == (b, h, sq, d)
+    if causal:
+        from paddle_tpu.nn.functional import _sdpa
+        ref = _sdpa.raw(q, k, v, None if bias is None
+                        else bias[:, None, None, :], d ** -0.5, True)
+    else:
+        ref = _ref(q, k, v, bias, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+    g1 = jax.grad(lambda *a: flash_attention(
+        *a, bias=bias, causal=causal).sum(), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda *a: (_sdpa.raw(
+        a[0], a[1], a[2], None if bias is None else bias[:, None, None, :],
+        d ** -0.5, causal)).sum(), argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4)
 
 
 def test_ring_attention_flash_path_matches():

@@ -1,0 +1,125 @@
+"""Shape-keyed Pallas block autotuning: measure-on-first-use, in-process +
+disk caching, flag overrides winning over the table."""
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import monitor
+from paddle_tpu.ops.pallas import autotune, flash_attention
+
+
+@pytest.fixture
+def tuning(tmp_path, monkeypatch):
+    """Interpret-mode measuring (FLAGS_pallas_autotune_force) with a fresh
+    disk cache file."""
+    cache = tmp_path / "autotune.json"
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_AUTOTUNE_CACHE", str(cache))
+    autotune.clear()
+    paddle.set_flags({"FLAGS_pallas_interpret": True,
+                      "FLAGS_pallas_autotune_force": True})
+    yield cache
+    paddle.set_flags({"FLAGS_pallas_interpret": False,
+                      "FLAGS_pallas_autotune_force": False})
+    autotune.clear()
+
+
+def test_bucketing():
+    assert autotune.bucket(1) == 1
+    assert autotune.bucket(8) == 8
+    assert autotune.bucket(33) == 64
+    assert autotune.bucket(1000) == 1024
+    assert autotune.bucket(1024) == 1024
+
+
+def test_lookup_measures_once_and_round_trips_disk(tuning):
+    calls = []
+
+    def measure(params):
+        calls.append(params)
+        return 0.001 if params == (64, 64) else 0.5
+
+    cands = [(128, 128), (64, 64)]
+    got = autotune.lookup("test_kernel", (128, 128), "float32", cands,
+                          measure, (128, 128))
+    assert got == (64, 64)
+    assert sorted(calls) == sorted(cands)
+
+    # second lookup: in-process hit, no re-measure
+    calls.clear()
+    got = autotune.lookup("test_kernel", (128, 128), "float32", cands,
+                          measure, (128, 128))
+    assert got == (64, 64) and not calls
+
+    # disk round-trip: a fresh process (cleared table) reloads the entry
+    data = json.loads(tuning.read_text())
+    assert any(k.startswith("test_kernel|128,128|float32|")
+               for k in data["entries"])
+    autotune.clear()
+    got = autotune.lookup("test_kernel", (128, 128), "float32", cands,
+                          measure, (128, 128))
+    assert got == (64, 64) and not calls
+
+
+def test_flash_attention_autotunes_and_caches(tuning):
+    """flash_attention at a multi-candidate shape measures once, writes
+    the disk cache, and the winner produces correct output."""
+    monitor.reset("pallas.autotune.measured.flash_fwd")
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 1, 128, 16), jnp.float32)
+    out1 = flash_attention(q, q, q)
+    assert monitor.stat_get("pallas.autotune.measured.flash_fwd") == 1
+    data = json.loads(tuning.read_text())
+    assert any(k.startswith("flash_fwd|") for k in data["entries"])
+
+    # same shape family again: table hit, no second measurement
+    out2 = flash_attention(q, q, q)
+    assert monitor.stat_get("pallas.autotune.measured.flash_fwd") == 1
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               atol=1e-6)
+
+
+def test_flag_override_wins_over_table(tuning):
+    """FLAGS_flash_block_* beats a table entry recorded for the shape."""
+    seen = []
+    import importlib
+    fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+    real = fa._flash
+
+    def spy(q, k, v, bias, scale, causal, heads, bq, bk, off):
+        seen.append((bq, bk))
+        return real(q, k, v, bias, scale, causal, heads, bq, bk, off)
+
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 1, 128, 16), jnp.float32)
+    flash_attention(q, q, q)  # seeds the table for this bucket
+    fa._flash, orig = spy, real
+    try:
+        paddle.set_flags({"FLAGS_flash_block_q": 32,
+                          "FLAGS_flash_block_k": 32})
+        flash_attention(q, q, q)
+        assert seen[-1] == (32, 32)
+    finally:
+        fa._flash = orig
+        paddle.set_flags({"FLAGS_flash_block_q": 0,
+                          "FLAGS_flash_block_k": 0})
+
+
+def test_corrupt_disk_cache_is_ignored(tuning):
+    tuning.write_text("{not json")
+    autotune.clear()
+    got = autotune.lookup("k", (8,), "float32", [(8,)], lambda p: 0.1, (8,))
+    assert got == (8,)
+
+
+def test_no_measure_off_tpu_without_force(tuning):
+    """Without the force flag, CPU lookups return the heuristic default
+    (interpret timings are meaningless)."""
+    paddle.set_flags({"FLAGS_pallas_autotune_force": False})
+    autotune.clear()
+    calls = []
+    got = autotune.lookup("k2", (64, 64), "float32", [(64, 64), (32, 32)],
+                          lambda p: calls.append(p) or 0.1, (64, 64))
+    assert got == (64, 64) and not calls
