@@ -1,8 +1,9 @@
 """Benchmarks on one TPU chip. Prints one JSON line per metric:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Modes (BENCH_MODE env): "all" (default) = bert + resnet + decode;
-or a single one of "bert" / "resnet" / "decode".
+Modes (BENCH_MODE env): "all" (default) = bert + resnet + decode +
+longseq + pipeline; or a single one of "bert" / "resnet" / "decode" /
+"longseq" / "pipeline".
 - bert   — flagship: BERT-base MLM training (BASELINE config 3). The
   FIRST stdout line; vs_baseline = measured MFU / 0.40 (the BASELINE.md
   north-star; the reference publishes no numbers of its own).
@@ -11,6 +12,10 @@ or a single one of "bert" / "resnet" / "decode".
   get wrong by hand — documented convention per VERDICT r03 weak #8).
 - decode — GPT incremental generation tokens/sec through the
   StaticKVCache scan path (VERDICT r03 item 2).
+- pipeline — static-executor TRAIN hot-loop steps/s: serial vs async
+  pipelined (in-flight steps, device-resident carry) vs scan-fused
+  megasteps (docs/async_executor.md). Valid on CPU too: it measures
+  per-step HOST overhead, the thing the pipeline removes.
 
 Peak bf16 flops per v5e chip: 197 TFLOP/s (v5e spec sheet figure).
 
@@ -524,6 +529,110 @@ def bench_longseq():
     }), flush=True)
 
 
+def bench_pipeline():
+    """Static-executor TRAIN hot loop: serial Executor.run vs the async
+    pipelined loop vs scan-fused megasteps, on a small dispatch-bound
+    program — the regime where per-step host overhead (feed conversion,
+    scope round-trip, fetch sync) dominates the compiled step itself.
+    Runs on CPU too (the evidence path): the win measured here is host
+    overhead, not device compute. Defaults mirror
+    tools/pipeline_lint.py PIPELINE_CFG (framework_lint cross-checks)."""
+    import jax  # noqa: F401  (backend init before timing)
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, ops, optimizer, static
+    from paddle_tpu.core import monitor
+    from paddle_tpu.static import PipelineRunner
+
+    batch = int(os.environ.get("BENCH_PIPE_BATCH", 256))
+    hidden = int(os.environ.get("BENCH_PIPE_HIDDEN", 64))
+    steps = int(os.environ.get("BENCH_PIPE_STEPS", 200))
+    scan_k = int(os.environ.get("BENCH_PIPE_SCAN_K", 8))
+    inflight = int(os.environ.get("BENCH_PIPE_INFLIGHT", 2))
+    warmup = 10
+    rng = np.random.RandomState(0)
+    n_batches = 16
+    xs = [rng.rand(batch, hidden).astype("float32")
+          for _ in range(n_batches)]
+    ys = [rng.rand(batch, 1).astype("float32") for _ in range(n_batches)]
+
+    def build(name):
+        paddle.seed(0)
+        prog = static.Program(name)
+        with static.program_guard(prog):
+            x = static.data("x", [-1, hidden], "float32")
+            y = static.data("y", [-1, 1], "float32")
+            h = ops.relu(nn.Linear(hidden, hidden)(x))
+            loss = ops.mse_loss(nn.Linear(hidden, 1)(h), y)
+            optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        return prog, loss
+
+    def feeds(n):
+        for i in range(n):
+            yield {"x": xs[i % n_batches], "y": ys[i % n_batches]}
+
+    paddle.enable_static()
+    try:
+        results = {}
+        overhead = {}
+        losses = {}
+        # serial: materialize every step (the pre-pipeline loop)
+        prog, loss = build("bench_serial")
+        exe = static.Executor()
+        paddle.seed(7)
+        for f in feeds(warmup):
+            exe.run(prog, feed=f, fetch_list=[loss])
+        t0 = time.perf_counter()
+        for f in feeds(steps):
+            out = exe.run(prog, feed=f, fetch_list=[loss])
+        results["serial"] = steps / (time.perf_counter() - t0)
+        losses["serial"] = float(np.asarray(out[0]))
+
+        def timed_runner(name, k):
+            prog, loss = build(f"bench_{name}")
+            exe = static.Executor()
+            paddle.seed(7)
+            with PipelineRunner(exe, prog, fetch_list=[loss],
+                                max_inflight=inflight, scan_steps=k) as r:
+                for _ in r.run(feeds(warmup)):
+                    pass
+                r.sync()
+                t0 = time.perf_counter()
+                last = None
+                for handles in r.run(feeds(steps)):
+                    last = handles
+                val = float(np.asarray(last[0]))
+                dt = time.perf_counter() - t0
+            results[name] = steps / dt
+            losses[name] = val
+            overhead[name] = monitor.stat_get("executor/host_overhead_ms")
+
+        timed_runner("pipelined", 0)
+        timed_runner("scan_fused", scan_k)
+
+        print(json.dumps({
+            "metric": f"static_train_hotloop_b{batch}_h{hidden}",
+            "value": round(results["pipelined"], 2),
+            "unit": "steps/sec",
+            "vs_baseline": round(results["pipelined"] / results["serial"],
+                                 4),
+            "pipeline": {
+                "inflight": inflight,
+                "scan_k": scan_k,
+                "steps_per_s": {k: round(v, 2)
+                                for k, v in results.items()},
+                "host_overhead_ms": {k: round(v, 4)
+                                     for k, v in overhead.items()},
+                "dispatches_per_step": {"serial": 1.0, "pipelined": 1.0,
+                                        "scan_fused": round(1.0 / scan_k,
+                                                            4)},
+            },
+            "loss_end": {k: round(v, 6) for k, v in losses.items()},
+            "steps": steps,
+        }), flush=True)
+    finally:
+        paddle.disable_static()
+
+
 def _probe_backend(timeout_s):
     """Fail fast when the TPU tunnel is wedged (init can hang forever on a
     stale pool lease): probe jax.devices() in a thread; on timeout, emit a
@@ -563,6 +672,12 @@ def main():
         except Exception as e:  # long-seq is additive evidence; never
             print(f"# longseq bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)  # block the primary lines
+    if mode in ("pipeline", "all"):
+        try:
+            bench_pipeline()
+        except Exception as e:  # additive evidence line, never blocking
+            print(f"# pipeline bench failed: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

@@ -138,6 +138,27 @@ define_flag("FLAGS_pallas_strict", False,
             "demotion bumps pallas.fallback.{kernel}.{reason} in "
             "core/monitor)")
 
+define_flag("FLAGS_executor_max_inflight", 2,
+            "async executor pipeline depth: how many dispatched-but-not-"
+            "materialized steps the training hot loop keeps queued "
+            "(static/pipeline_runner.py). jax dispatch is non-blocking, so "
+            "N in-flight steps keep the device busy while the host "
+            "converts/prefetches the next batches; fetches materialize "
+            "only at print_period/callback/epoch boundaries. 0 restores "
+            "the fully synchronous per-step loop")
+define_flag("FLAGS_executor_scan_steps", 0,
+            "scan-fused megasteps: when > 1 and the feed shapes are "
+            "stable, the pipelined loop stacks K batches and runs ONE "
+            "compiled lax.scan over the existing step — 1 dispatch per K "
+            "steps instead of K, bitwise-equal to the serial loop (RNG "
+            "keys, lr/t schedule threaded per iteration). Opt-in: "
+            "dispatch-bound small programs win; large programs are "
+            "already compute-bound. 0/1 disables fusion")
+define_flag("FLAGS_executor_cache_size", 32,
+            "LRU bound on the Executor's compiled-program cache (entries "
+            "keyed on program.uid + feed/fetch signature); evictions bump "
+            "executor/cache_evictions in core/monitor")
+
 # --- PS transport fault tolerance (distributed/ps/rpc.py) ---------------
 # The reference's brpc channel exposes the same three knobs
 # (connect_timeout_ms / timeout_ms / max_retry in brpc_ps_client.cc);

@@ -120,8 +120,10 @@ class ProgBarLogger(Callback):
         for k, v in logs.items():
             if k == "batch_size":
                 continue
-            if isinstance(v, numbers.Number):
-                parts.append(f"{k}: {v:.4f}")
+            # float-convertibles cover the async fit loop's _LazyLoss
+            # (hapi/model.py), which materializes its exact loss on read
+            if isinstance(v, numbers.Number) or hasattr(v, "__float__"):
+                parts.append(f"{k}: {float(v):.4f}")
         return " - ".join(parts)
 
     def on_train_batch_end(self, step, logs=None):

@@ -34,6 +34,54 @@ from .callbacks import config_callbacks
 __all__ = ["Model", "InputSpec"]
 
 
+class _LazyLoss:
+    """`logs["loss"]` placeholder in the async fit loop
+    (docs/async_executor.md): materializes the EXACT loss of its own step
+    on first read (float()/format()/np.asarray), draining the window in
+    submission order so an in-flight failure names the first failing
+    step. A callback that consumes the loss every batch (e.g. VisualDL's
+    add_scalar) therefore sees exact per-batch values at per-batch sync
+    cost; a loop where nothing reads it keeps the pipeline."""
+
+    __slots__ = ("step", "_lval", "_drain", "_val")
+
+    def __init__(self, step, lval, drain):
+        self.step = step
+        self._lval = lval
+        self._drain = drain
+        self._val = None
+
+    def _materialize(self):
+        """Called by the window drain, in submission order."""
+        if self._val is None:
+            try:
+                self._val = float(np.asarray(self._lval))
+            except Exception as e:
+                raise RuntimeError(
+                    f"hapi pipelined step {self.step} failed: "
+                    f"{type(e).__name__}: {e}") from e
+            self._lval = None
+        return self._val
+
+    def value(self):
+        if self._val is None:
+            self._drain(self.step)  # in-order: names the first failure
+        return self._val if self._val is not None else self._materialize()
+
+    def __float__(self):
+        return self.value()
+
+    def __format__(self, spec):
+        return format(self.value(), spec)
+
+    def __repr__(self):
+        return repr(self.value())
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(self.value())
+        return arr.astype(dtype) if dtype is not None else arr
+
+
 class InputSpec:
     """Shape/dtype declaration (reference paddle/static/input.py InputSpec)."""
 
@@ -797,7 +845,8 @@ class Model:
                                            num_iters=num_iters,
                                            accum=accumulate_grad_batches,
                                            epoch=epoch,
-                                           skip_steps=skip_steps)
+                                           skip_steps=skip_steps,
+                                           log_freq=log_freq)
                 skip_steps = 0
                 cbks.on_epoch_end(epoch, logs)
                 if do_eval and epoch % eval_freq == 0:
@@ -860,11 +909,35 @@ class Model:
         return merged
 
     def _run_one_epoch(self, loader, cbks, mode, num_iters=None, accum=1,
-                       epoch=0, skip_steps=0):
+                       epoch=0, skip_steps=0, log_freq=10):
+        from collections import deque
+        from ..core import flags as _flags
         for m in self._metrics:
             m.reset()
         logs = {}
         acp = getattr(self, "_acp", None)
+        # async hot loop (docs/async_executor.md): the per-step
+        # `float(np.asarray(loss))` host sync was the only thing forcing
+        # the loop to wait for the device. With no metrics (metric.update
+        # reads the outputs on host every batch) and no grad accumulation
+        # bookkeeping, logs["loss"] becomes a _LazyLoss and the window
+        # keeps up to FLAGS_executor_max_inflight steps un-materialized;
+        # it drains at log_freq boundaries, at the window bound, and
+        # whenever a consumer actually reads a loss. An in-flight failure
+        # surfaces at the next drain, naming the step.
+        inflight = int(_flags.flag("FLAGS_executor_max_inflight"))
+        async_loop = (mode == "train" and inflight > 0
+                      and not self._metrics and accum <= 1)
+        window: deque = deque()
+
+        def drain(through=None):
+            # through=None retires only past the window bound; a boundary
+            # passes `through` to materialize everything up to that step
+            while window and ((through is not None
+                               and window[0].step <= through)
+                              or len(window) > inflight):
+                window.popleft()._materialize()
+
         for step, batch in enumerate(loader):
             if step < skip_steps:
                 continue  # resumed mid-epoch: fast-forward consumed batches
@@ -875,7 +948,16 @@ class Model:
                                                   update=update)
             if self._lr_sched_step_on_batch():
                 self._optimizer._learning_rate.step()
-            logs["loss"] = float(np.asarray(lval))
+            if async_loop:
+                lazy = _LazyLoss(step, lval, drain)
+                window.append(lazy)
+                if (step + 1) % max(log_freq, 1) == 0:
+                    drain(through=step)  # boundary: window fully retired
+                else:
+                    drain()  # retire past the window bound only
+                logs["loss"] = lazy  # exact for whoever reads it
+            else:
+                logs["loss"] = float(np.asarray(lval))
             logs["batch_size"] = np.asarray(inputs[0]).shape[0]
             metric_logs = self._update_metrics(outs, labels)
             logs.update(metric_logs)
@@ -888,6 +970,10 @@ class Model:
             cbks.on_batch_end(mode, step, logs)
             if num_iters is not None and step + 1 >= num_iters:
                 break
+        if window:  # epoch boundary: materialize the tail
+            drain(through=window[-1].step)
+        if async_loop and isinstance(logs.get("loss"), _LazyLoss):
+            logs["loss"] = logs["loss"].value()  # plain float leaves fit
         if self._lr_sched_step_on_epoch():
             self._optimizer._learning_rate.step()
         return logs

@@ -129,7 +129,12 @@ class DataLoader:
         def producer():
             try:
                 for indices in self.batch_sampler:
-                    fut = pool.submit(fetch, indices)
+                    try:
+                        fut = pool.submit(fetch, indices)
+                    except RuntimeError:
+                        # consumer abandoned the iterator and its finally
+                        # block shut the pool down between our iterations
+                        return
                     while not stop.is_set():  # bounded put that can abort
                         try:
                             q.put(fut, timeout=0.1)
@@ -156,7 +161,16 @@ class DataLoader:
                 yield item.result()
         finally:
             stop.set()  # unblock producer if the consumer bailed early
-            pool.shutdown(wait=False)
+            try:  # drop buffered futures so queued work doesn't run
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            # an abandoned iterator (GeneratorExit) must not leak the
+            # pool: cancel queued fetches and JOIN the workers — with
+            # wait=False the pool threads lived until process exit
+            pool.shutdown(wait=True, cancel_futures=True)
+            t.join(timeout=5)
 
     def _batches_multiprocess(self):
         """Forked worker processes; batches re-ordered by index so epoch
@@ -250,3 +264,14 @@ class DataLoader:
                 yield item
         finally:
             stop.set()  # consumer abandoned mid-epoch: release the producer
+            try:  # unblock a producer stuck on a full queue
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=5)  # producer closes `gen` on its way out,
+            if not t.is_alive():  # which shuts the worker pool down too
+                try:
+                    gen.close()  # no-op if already closed/exhausted
+                except RuntimeError:
+                    pass

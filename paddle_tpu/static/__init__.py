@@ -15,6 +15,8 @@ from ..hapi.model import InputSpec  # noqa: F401
 from . import amp  # noqa: F401
 from .executor import (BuildStrategy, CompiledProgram, ExecutionStrategy,  # noqa: F401
                        Executor)
+from .pipeline_runner import (FetchHandle, PipelineRunner,  # noqa: F401
+                              PipelineStepError)
 from .program import (Program, Variable, StaticParam, default_main_program,  # noqa: F401
                       default_startup_program, disable_static_,
                       enable_static_, global_scope, in_static_mode,
@@ -40,7 +42,8 @@ __all__ = ["data", "InputSpec", "Program", "Variable", "Executor",
            "analyze_program", "analyze_params", "SpmdLintError",
            "SpmdReport", "SpmdDiagnostic", "Collective",
            "register_spmd_rule", "set_verify_spmd", "verify_spmd_enabled",
-           "maybe_verify_spmd"]
+           "maybe_verify_spmd", "PipelineRunner", "FetchHandle",
+           "PipelineStepError"]
 
 
 def data(name, shape, dtype="float32", lod_level=0):
@@ -178,7 +181,7 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
     # lower the pruned program once and export it with params baked in
     entry = executor._compile(pruned, sorted(feed_names),
                               [v.var_id for v in fetch_vars], False)
-    step, persist_names, _opt, _amp_init = entry
+    step, persist_names = entry.step_fn, entry.read_names
     scope = global_scope()
     scope_vals = {n: scope.get(n) for n in persist_names}
     order = {n: i for i, n in enumerate(sorted(feed_names))}

@@ -10,6 +10,7 @@ scope', slots'), with scope/slots donated.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -28,6 +29,108 @@ def _resolve(arg, env):
     return arg
 
 
+def _convert_feed(val, aval, sharding=None):
+    """One feed value -> device array of the program's declared dtype.
+
+    A value that is ALREADY a jax array of the right dtype passes through
+    untouched — the old unconditional `jnp.asarray(np.asarray(val))`
+    forced a device->host->device round trip on every step for callers
+    that keep their batches device-resident (bench loops, the pipeline
+    prefetcher feeding its own output back)."""
+    from ..core.tensor import Tensor
+    if isinstance(val, Tensor) and val._value is not None:
+        val = val._value
+    if isinstance(val, jax.Array) and val.dtype == aval.dtype:
+        return val  # jit re-shards if the placement disagrees
+    arr = np.asarray(val)
+    if sharding is not None:
+        if arr.dtype != aval.dtype:
+            arr = arr.astype(aval.dtype)
+        return jax.device_put(arr, sharding)
+    return jnp.asarray(arr, aval.dtype)
+
+
+def _dp_shardings():
+    """(mesh, replicated, batch) NamedShardings when a dp mesh with >1
+    device is active, else None — shared by _compile and the pipeline
+    prefetcher so both put feeds where the compiled step expects them."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..distributed import mesh as mesh_mod
+    mesh = mesh_mod.auto_mesh()
+    if "dp" not in mesh.axis_names or mesh.shape["dp"] <= 1:
+        return None
+    return mesh, NamedSharding(mesh, P()), NamedSharding(mesh, P("dp"))
+
+
+def make_scan_step(step_fn):
+    """lax.scan over one compiled step: the scan-fused K-batch megastep
+    body. ONE definition shared by _CompiledEntry.scan_jitted (production)
+    and tools/hlo_evidence.py (the lowered proof), so the evidence is for
+    the computation the runtime actually executes."""
+
+    def scan_step(feeds, scope_vals, slots, lrs, ts, keys):
+        def body(carry, x):
+            sv, sl = carry
+            feed_tuple, lr, t, key = x
+            fetches, new_sv, new_sl = step_fn(feed_tuple, sv, sl, lr, t,
+                                              key)
+            return (new_sv, new_sl), fetches
+
+        (new_sv, new_sl), fetches = jax.lax.scan(
+            body, (scope_vals, slots), (feeds, lrs, ts, keys))
+        return fetches, new_sv, new_sl
+
+    return scan_step
+
+
+class _CompiledEntry:
+    """One lowered program: the jitted step, the raw (unjitted) step for
+    scan fusion, and the host-side metadata the run loops need."""
+
+    __slots__ = ("jitted", "step_fn", "feed_names", "fetch_ids",
+                 "read_names", "opt", "opt_pnames", "amp_init", "donate",
+                 "dp", "_scan_jitted")
+
+    def __init__(self, jitted, step_fn, feed_names, fetch_ids, read_names,
+                 opt, opt_pnames, amp_init, donate, dp):
+        self.jitted = jitted
+        self.step_fn = step_fn
+        self.feed_names = list(feed_names)   # sorted; feed-tuple order
+        self.fetch_ids = list(fetch_ids)
+        self.read_names = list(read_names)
+        self.opt = opt
+        self.opt_pnames = list(opt_pnames)
+        self.amp_init = amp_init
+        self.donate = donate
+        self.dp = dp                          # None | (mesh, repl, batch)
+        self._scan_jitted = None
+
+    def scan_jitted(self):
+        """jit(lax.scan(step)) — ONE dispatch runs K stacked batches
+        (K is implicit in the stacked leading dim; jax re-specializes per
+        K/shape). Bitwise-equal to K serial steps: the scanned body IS
+        the serial step function, and the per-step (lr, t, key) stream is
+        precomputed on host exactly as the serial loop would."""
+        if self._scan_jitted is None:
+            scan_step = make_scan_step(self.step_fn)
+            donate = tuple(d for d in self.donate)  # (1, 2) or ()
+            if self.dp is None:
+                self._scan_jitted = jax.jit(scan_step,
+                                            donate_argnums=donate)
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                mesh, repl, _batch = self.dp
+                scan_batch = NamedSharding(mesh, P(None, "dp"))
+                self._scan_jitted = jax.jit(
+                    scan_step,
+                    in_shardings=(
+                        (scan_batch,) * len(self.feed_names),
+                        {n: repl for n in self.read_names},
+                        None, repl, repl, repl),
+                    donate_argnums=donate)
+        return self._scan_jitted
+
+
 class BuildStrategy:
     """Parity shim for fluid.BuildStrategy (details/build_strategy.cc):
     XLA owns fusion/memory decisions, so knobs are accepted and recorded."""
@@ -44,6 +147,10 @@ class ExecutionStrategy:
     def __init__(self):
         self.num_threads = 1
         self.num_iteration_per_drop_scope = 100
+        # async hot-loop knobs (None = inherit the FLAGS_executor_*
+        # defaults; see docs/async_executor.md)
+        self.max_inflight = None      # FLAGS_executor_max_inflight
+        self.scan_fuse_steps = None   # FLAGS_executor_scan_steps
 
 
 class CompiledProgram:
@@ -51,9 +158,10 @@ class CompiledProgram:
     (:164) marks the batch axis for 'dp' mesh sharding instead of cloning
     the program per device (parallel_executor.cc:606)."""
 
-    def __init__(self, program, build_strategy=None):
+    def __init__(self, program, build_strategy=None, exec_strategy=None):
         self.program = program
         self.build_strategy = build_strategy or BuildStrategy()
+        self.exec_strategy = exec_strategy or ExecutionStrategy()
         self.data_parallel = False
         self.loss_name = None
 
@@ -63,17 +171,93 @@ class CompiledProgram:
         self.loss_name = loss_name
         if build_strategy is not None:
             self.build_strategy = build_strategy
+        if exec_strategy is not None:
+            self.exec_strategy = exec_strategy
         return self
 
 
 class Executor:
     def __init__(self, place=None):
         self.place = place
-        self._cache = {}
+        self._cache: "OrderedDict" = OrderedDict()
+
+    # -- compiled-entry cache ------------------------------------------------
+    def _prepare(self, program, feed_vals, fetch_list, data_parallel):
+        """Resolve fetches and return the cached _CompiledEntry, compiling
+        on miss. Keyed on program.uid (NOT id(program): a garbage-collected
+        Program whose id the allocator reuses for a new Program would hit
+        a stale compiled entry — the AMP state tags learned this first),
+        with an LRU bound so long-lived executors serving many programs
+        don't hold every lowering forever."""
+        fetch_ids = []
+        for f in fetch_list:
+            if isinstance(f, str):
+                matches = [v for v in program.list_vars() if v.name == f]
+                if not matches:
+                    raise KeyError(f"fetch '{f}' not found in program")
+                fetch_ids.append(matches[0].var_id)
+            else:
+                fetch_ids.append(f.var_id)
+
+        key = (program.uid, program._version, tuple(sorted(feed_vals)),
+               tuple(v.shape for _, v in sorted(feed_vals.items())),
+               tuple(fetch_ids), data_parallel)
+        entry = self._cache.get(key)
+        if entry is not None:
+            self._cache.move_to_end(key)
+            return entry
+        from .. import profiler as _prof
+        from ..core import flags as _flags0
+        from ..core import monitor as _monitor
+        # PADDLE_TPU_VERIFY_SPMD: sharding findings (unbound axis,
+        # non-divisible dim, implied reshard, ...) fail HERE — before
+        # jit tracing, where they would surface as silent replication
+        # or an opaque XLA error (mirrors PADDLE_TPU_VERIFY_PASSES)
+        from .spmd_analyzer import maybe_verify_spmd
+        spmd_rep = maybe_verify_spmd(program)
+        with _prof.RecordEvent("executor/lower_program"):
+            entry = self._compile(program, sorted(feed_vals), fetch_ids,
+                                  data_parallel)
+        self._cache[key] = entry
+        cap = max(1, int(_flags0.flag("FLAGS_executor_cache_size")))
+        while len(self._cache) > cap:
+            self._cache.popitem(last=False)
+            _monitor.stat_add("executor/cache_evictions")
+        _monitor.stat_add("executor/lowerings")
+        if _flags0.flag("FLAGS_log_memory_estimate"):
+            from .shape_infer import analyze_memory
+            est = analyze_memory(program)
+            _monitor.stat_set("executor/estimated_peak_bytes",
+                              est["peak_bytes"])
+        # spmd_rep already published the gauges when the strict hook
+        # ran — don't re-walk the program for the same numbers
+        if _flags0.flag("FLAGS_log_spmd_estimate") and spmd_rep is None:
+            from ..distributed import mesh as _mesh_mod
+            if _mesh_mod.get_mesh() is not None:
+                from .spmd_analyzer import analyze_program
+                analyze_program(
+                    program,
+                    param_specs=getattr(program, "spmd_param_specs",
+                                        None),
+                    data_specs=getattr(program, "spmd_data_specs",
+                                       None)).publish()
+        return entry
+
+    @staticmethod
+    def _convert_feeds(program, feed):
+        feed_vals = {}
+        for name, val in feed.items():
+            var = program.data_vars.get(name)
+            if var is None:
+                raise KeyError(f"feed '{name}' is not a data variable of the "
+                               f"program (have {list(program.data_vars)})")
+            feed_vals[name] = _convert_feed(val, var.aval)
+        return feed_vals
 
     # -- public API ----------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, use_program_cache=True):
+            return_numpy=True, use_program_cache=True,
+            return_handles=False):
         feed = feed or {}
         fetch_list = list(fetch_list or [])
         data_parallel = False
@@ -88,71 +272,18 @@ class Executor:
             return []
         scope = scope or global_scope()
 
-        feed_vals = {}
-        for name, val in feed.items():
-            var = program.data_vars.get(name)
-            if var is None:
-                raise KeyError(f"feed '{name}' is not a data variable of the "
-                               f"program (have {list(program.data_vars)})")
-            feed_vals[name] = jnp.asarray(np.asarray(val), var.aval.dtype)
+        feed_vals = self._convert_feeds(program, feed)
+        entry = self._prepare(program, feed_vals, fetch_list, data_parallel)
 
-        fetch_ids = []
-        for f in fetch_list:
-            if isinstance(f, str):
-                matches = [v for v in program.list_vars() if v.name == f]
-                if not matches:
-                    raise KeyError(f"fetch '{f}' not found in program")
-                fetch_ids.append(matches[0].var_id)
-            else:
-                fetch_ids.append(f.var_id)
-
-        key = (id(program), program._version, tuple(sorted(feed_vals)),
-               tuple(v.shape for _, v in sorted(feed_vals.items())),
-               tuple(fetch_ids), data_parallel)
-        entry = self._cache.get(key)
-        if entry is None:
-            from .. import profiler as _prof
-            from ..core import monitor as _monitor
-            # PADDLE_TPU_VERIFY_SPMD: sharding findings (unbound axis,
-            # non-divisible dim, implied reshard, ...) fail HERE — before
-            # jit tracing, where they would surface as silent replication
-            # or an opaque XLA error (mirrors PADDLE_TPU_VERIFY_PASSES)
-            from .spmd_analyzer import maybe_verify_spmd
-            spmd_rep = maybe_verify_spmd(program)
-            with _prof.RecordEvent("executor/lower_program"):
-                entry = self._compile(program, sorted(feed_vals), fetch_ids,
-                                      data_parallel)
-            self._cache[key] = entry
-            _monitor.stat_add("executor/lowerings")
-            from ..core import flags as _flags0
-            if _flags0.flag("FLAGS_log_memory_estimate"):
-                from .shape_infer import analyze_memory
-                est = analyze_memory(program)
-                _monitor.stat_set("executor/estimated_peak_bytes",
-                                  est["peak_bytes"])
-            # spmd_rep already published the gauges when the strict hook
-            # ran — don't re-walk the program for the same numbers
-            if _flags0.flag("FLAGS_log_spmd_estimate") and spmd_rep is None:
-                from ..distributed import mesh as _mesh_mod
-                if _mesh_mod.get_mesh() is not None:
-                    from .spmd_analyzer import analyze_program
-                    analyze_program(
-                        program,
-                        param_specs=getattr(program, "spmd_param_specs",
-                                            None),
-                        data_specs=getattr(program, "spmd_data_specs",
-                                           None)).publish()
-        step, persist_names, opt, amp_init = entry
-
-        for n, v0 in (amp_init or {}).items():
+        for n, v0 in (entry.amp_init or {}).items():
             if not scope.has(n):
                 scope.set(n, v0)
-        scope_vals = {n: scope.get(n) for n in persist_names}
+        scope_vals = {n: scope.get(n) for n in entry.read_names}
         slots, lr, t = {}, jnp.zeros(()), jnp.zeros((), jnp.int32)
+        opt = entry.opt
         if opt is not None:
-            pnames = [p.scope_name for p, _ in program.optimizer_section[1]]
-            opt._ensure_slots({n: scope_vals[n] for n in pnames})
-            slots = {n: opt._slots[n] for n in pnames}
+            opt._ensure_slots({n: scope_vals[n] for n in entry.opt_pnames})
+            slots = {n: opt._slots[n] for n in entry.opt_pnames}
             opt._step_count += 1
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             t = jnp.asarray(opt._step_count, jnp.int32)
@@ -162,12 +293,16 @@ class Executor:
         from .. import profiler as _prof
         _monitor.stat_add("executor/runs")
         with _prof.RecordEvent("executor/run_step"):
-            fetches, new_scope, new_slots = step(
-                tuple(feed_vals[n] for n in sorted(feed_vals)), scope_vals,
+            fetches, new_scope, new_slots = entry.jitted(
+                tuple(feed_vals[n] for n in entry.feed_names), scope_vals,
                 slots, lr, t, _rng.next_key())
 
         from ..core import flags as _flags
         if _flags.flag("FLAGS_check_nan_inf"):
+            # sweep BEFORE the write-back (never commit NaN state), in
+            # return_handles mode too — the nan check is a debugging
+            # mode and a param-only NaN would otherwise slip past the
+            # per-fetch sweep in FetchHandle.numpy()
             from ..core.numeric_check import sweep
             sweep({"fetches": list(fetches), "scope": new_scope},
                   "Executor.run step")
@@ -176,6 +311,14 @@ class Executor:
             scope.set(n, v)
         if opt is not None:
             opt._slots.update(new_slots)
+
+        if return_handles:
+            # async mode: dispatch is already queued; hand back lazy
+            # handles so the caller materializes at its own boundaries
+            from .pipeline_runner import FetchHandle
+            idx = int(_monitor.stat_get("executor/runs")) - 1
+            return [FetchHandle(f, idx) for f in fetches]
+
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
@@ -207,6 +350,7 @@ class Executor:
         server's accessor owns the update rule."""
         if dataset is None:
             raise ValueError("train_from_dataset requires a dataset")
+        from ..core import flags as _flags
         from ..core import monitor as _monitor
         program_ = program if not isinstance(program, CompiledProgram) \
             else program.program
@@ -214,6 +358,40 @@ class Executor:
         dp = _DownpourDriver(program_ or default_main_program(),
                              scope, ps_config) if ps_config else None
         base_fetch = list(fetch_list or [])
+
+        es = program.exec_strategy if isinstance(program, CompiledProgram) \
+            else None
+        inflight = getattr(es, "max_inflight", None)
+        if inflight is None:
+            inflight = _flags.flag("FLAGS_executor_max_inflight")
+
+        if dp is None and inflight > 0:
+            # async hot path: in-flight steps + device-resident carry +
+            # (opt-in) scan-fused megasteps; fetches materialize only at
+            # the print boundary (docs/async_executor.md)
+            from .pipeline_runner import PipelineRunner
+            names = fetch_info or [getattr(f, "name", str(f))
+                                   for f in (fetch_list or [])]
+            it = 0
+            with PipelineRunner(
+                    self, program, fetch_list=base_fetch, scope=scope,
+                    max_inflight=inflight,
+                    scan_steps=getattr(es, "scan_fuse_steps", None)) \
+                    as runner:
+                for handles in runner.run(dataset.batches()):
+                    _monitor.stat_add("executor/dataset_batches")
+                    it += 1
+                    if debug or (fetch_list and print_period
+                                 and it % print_period == 0):
+                        msg = ", ".join(
+                            f"{n}={np.asarray(h).mean():.6f}"
+                            for n, h in zip(names, handles))
+                        print(f"batch {it}: {msg}")
+            return None
+
+        # synchronous loop: the Downpour pre/post hooks read AND write the
+        # scope around every batch (sparse pull into the param, grad rows
+        # pushed after) — a per-step host sync boundary by construction
         it = 0
         for feed in dataset.batches():
             if dp is not None:
@@ -458,21 +636,21 @@ class Executor:
             else ()
         jitted = jax.jit(step, donate_argnums=donate)
 
-        if data_parallel:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            from ..distributed import mesh as mesh_mod
-            mesh = mesh_mod.auto_mesh()
-            if "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
-                repl = NamedSharding(mesh, P())
-                batch = NamedSharding(mesh, P("dp"))
-                jitted = jax.jit(
-                    step,
-                    in_shardings=((batch,) * len(feed_names),
-                                  {n: repl for n in read_names},
-                                  None, repl, repl, repl),
-                    donate_argnums=donate)
+        dp = _dp_shardings() if data_parallel else None
+        if dp is not None:
+            mesh, repl, batch = dp
+            jitted = jax.jit(
+                step,
+                in_shardings=((batch,) * len(feed_names),
+                              {n: repl for n in read_names},
+                              None, repl, repl, repl),
+                donate_argnums=donate)
 
-        return jitted, read_names, opt, amp_init
+        opt_pnames = [p.scope_name for p, _ in opt_sec[1]] \
+            if opt is not None else []
+        return _CompiledEntry(jitted, step, sorted(feed_names), fetch_ids,
+                              read_names, opt, opt_pnames, amp_init,
+                              donate, dp)
 
 
 class _DownpourDriver:

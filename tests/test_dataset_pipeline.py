@@ -177,3 +177,29 @@ def test_dataloader_thread_fallback_still_works():
     dl = DataLoader(_SlowSquares(), batch_size=8, num_workers=2,
                     use_shared_memory=False)
     assert len(list(dl)) == 4
+
+
+def test_dataloader_abandoned_iterator_shuts_down_threads():
+    """A consumer that bails mid-epoch (GeneratorExit) must not leak the
+    ThreadPoolExecutor workers / producer threads — before the fix they
+    lived until process exit."""
+    import threading
+    import time
+
+    from paddle_tpu.io import DataLoader
+
+    before = set(threading.enumerate())
+    dl = DataLoader(_SlowSquares(), batch_size=4, num_workers=2,
+                    use_shared_memory=False)
+    it = iter(dl)
+    next(it)  # pools + producer threads are now live
+    spawned = [t for t in threading.enumerate() if t not in before]
+    assert spawned, "expected loader worker threads while iterating"
+    it.close()  # GeneratorExit through both generator layers
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if not any(t.is_alive() for t in spawned):
+            break
+        time.sleep(0.05)
+    leaked = [t.name for t in spawned if t.is_alive()]
+    assert not leaked, f"loader threads leaked after close: {leaked}"

@@ -1,0 +1,393 @@
+"""Async pipelined executor hot loop (static/pipeline_runner.py):
+serial vs pipelined vs scan-fused bitwise parity (params, optimizer
+slots, AMP loss-scale state, fetches), in-flight failure surfacing with
+the step index named, the uid-keyed LRU program cache, and the feed
+fast path. See docs/async_executor.md."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops, optimizer, static
+from paddle_tpu.core import monitor
+from paddle_tpu.static import (FetchHandle, PipelineRunner,
+                               PipelineStepError)
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _build(name, amp=False):
+    """Small train program: 2-layer MLP + mse, Adam (momentum slots);
+    optionally fp16 dynamic-loss-scaling AMP (scale/good/bad state rides
+    the compiled step)."""
+    paddle.seed(0)
+    prog = static.Program(name)
+    with static.program_guard(prog):
+        x = static.data("x", [-1, 4], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        h = ops.relu(nn.Linear(4, 8)(x))
+        loss = ops.mse_loss(nn.Linear(8, 1)(h), y)
+        opt = optimizer.Adam(learning_rate=0.05)
+        if amp:
+            opt = static.amp.decorate(opt, level="O1", dtype="float16",
+                                      init_loss_scaling=2.0 ** 8,
+                                      incr_every_n_steps=3)
+        opt.minimize(loss)
+    return prog, loss, opt
+
+
+def _feeds(n, batch=8):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(batch, 4).astype("float32"),
+             "y": rng.rand(batch, 1).astype("float32")}
+            for _ in range(n)]
+
+
+def _amp_state(prog):
+    scope = static.global_scope()
+    return {k.split("@")[0]: np.asarray(scope.get(k))
+            for k in scope.var_names()
+            if "@" in k and k.rsplit("#", 1)[-1] == str(prog.uid)}
+
+
+def _slot_arrays(opt):
+    # amp.decorate's wrapper __getattr__-delegates _slots to the inner
+    # opt; insertion order == param creation order, stable across builds
+    # (the NAMES differ per build: each program mints fresh params)
+    return [np.asarray(v) for _, s in opt._slots.items()
+            for _, v in sorted(s.items())]
+
+
+def _run_serial(n, amp=False):
+    prog, loss, opt = _build(f"serial_amp{amp}", amp=amp)
+    exe = static.Executor()
+    paddle.seed(123)
+    vals = [np.asarray(exe.run(prog, feed=f, fetch_list=[loss])[0])
+            for f in _feeds(n)]
+    params = [np.asarray(static.global_scope().get(n_))
+              for n_ in prog.persist_ids]  # creation order, build-stable
+    return vals, params, _slot_arrays(opt), _amp_state(prog)
+
+
+def _run_pipelined(n, inflight, scan, amp=False):
+    prog, loss, opt = _build(f"pipe{inflight}_{scan}_amp{amp}", amp=amp)
+    exe = static.Executor()
+    paddle.seed(123)
+    with PipelineRunner(exe, prog, fetch_list=[loss],
+                        max_inflight=inflight, scan_steps=scan) as r:
+        handles = [h[0] for h in r.run(iter(_feeds(n)))]
+        vals = [h.numpy() for h in handles]
+    params = [np.asarray(static.global_scope().get(n_))
+              for n_ in prog.persist_ids]  # creation order, build-stable
+    return vals, params, _slot_arrays(opt), _amp_state(prog)
+
+
+def _assert_bitwise(a, b, what):
+    vals_a, params_a, slots_a, amp_a = a
+    vals_b, params_b, slots_b, amp_b = b
+    for i, (va, vb) in enumerate(zip(vals_a, vals_b)):
+        np.testing.assert_array_equal(va, vb,
+                                      err_msg=f"{what}: fetch step {i}")
+    assert len(params_a) == len(params_b)
+    for i, (pa, pb) in enumerate(zip(params_a, params_b)):
+        np.testing.assert_array_equal(pa, pb, err_msg=f"{what}: param {i}")
+    assert len(slots_a) == len(slots_b) and len(slots_a) > 0
+    for sa, sb in zip(slots_a, slots_b):
+        np.testing.assert_array_equal(sa, sb, err_msg=f"{what}: slots")
+    assert sorted(amp_a) == sorted(amp_b)
+    for k in amp_a:
+        np.testing.assert_array_equal(amp_a[k], amp_b[k],
+                                      err_msg=f"{what}: amp {k}")
+
+
+@pytest.mark.parametrize("inflight", [1, 2, 4])
+def test_pipelined_bitwise_equals_serial(inflight):
+    serial = _run_serial(7)
+    pipe = _run_pipelined(7, inflight, 0)
+    _assert_bitwise(serial, pipe, f"inflight={inflight}")
+
+
+@pytest.mark.parametrize("scan_k", [2, 3])
+def test_scan_fused_bitwise_equals_serial(scan_k):
+    # 7 steps at K=3 -> 2 megasteps + 1 unfused remainder
+    serial = _run_serial(7)
+    pipe = _run_pipelined(7, 2, scan_k)
+    _assert_bitwise(serial, pipe, f"scan_k={scan_k}")
+    assert monitor.stat_get("executor/scan_megasteps") > 0
+
+
+def test_pipelined_amp_loss_scale_state_bitwise():
+    # fp16 dynamic loss scaling: _amp_{loss_scale,good,bad} state rides
+    # the carry; incr_every_n_steps=3 over 7 clean steps moves it
+    serial = _run_serial(7, amp=True)
+    assert serial[3], "amp state must exist for this test to mean anything"
+    _assert_bitwise(serial, _run_pipelined(7, 2, 0, amp=True),
+                    "amp inflight=2")
+    _assert_bitwise(serial, _run_pipelined(7, 2, 3, amp=True),
+                    "amp scan_k=3")
+
+
+def test_scan_handles_shape_change_unfused():
+    # feed shapes break mid-stream: the prefetcher must run the odd
+    # batches unfused and stay bitwise-correct
+    feeds = _feeds(4) + _feeds(3, batch=5) + _feeds(2)
+    prog, loss, _ = _build("shape_serial")
+    exe = static.Executor()
+    paddle.seed(123)
+    serial = [np.asarray(exe.run(prog, feed=f, fetch_list=[loss])[0])
+              for f in feeds]
+    prog2, loss2, _ = _build("shape_scan")
+    exe2 = static.Executor()
+    paddle.seed(123)
+    with PipelineRunner(exe2, prog2, fetch_list=[loss2], max_inflight=2,
+                        scan_steps=2) as r:
+        vals = [h[0].numpy() for h in r.run(iter(feeds))]
+    for a, b in zip(serial, vals):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_inflight_failure_surfaces_at_next_materialization():
+    prog, loss, _ = _build("chaos")
+    exe = static.Executor()
+    runner = PipelineRunner(exe, prog, fetch_list=[loss], max_inflight=4)
+    feeds = _feeds(6)
+    h0 = runner.submit(feeds[0])[0]  # compiles the entry
+    entry = runner._entry
+    orig = entry.jitted
+    calls = {"n": 0}
+
+    def bomb(*a, **k):
+        calls["n"] += 1
+        if calls["n"] == 2:  # step index 2 overall (0 ran unpatched)
+            raise RuntimeError("injected chaos")
+        return orig(*a, **k)
+
+    entry.jitted = bomb
+    try:
+        h1 = runner.submit(feeds[1])[0]
+        h2 = runner.submit(feeds[2])[0]   # fails in-flight, NOT raised here
+        h3 = runner.submit(feeds[3])[0]   # pipeline broken: skipped
+        # earlier steps still materialize fine
+        assert float(h0.numpy()) > 0 and float(h1.numpy()) > 0
+        # the failure surfaces at the next materialization, naming step 2
+        with pytest.raises(PipelineStepError, match="step 2"):
+            h2.numpy()
+        # ... and a LATER handle still names the FIRST failing step
+        with pytest.raises(PipelineStepError, match="step 2"):
+            h3.numpy()
+        with pytest.raises(PipelineStepError, match="step 2") as ei:
+            runner.sync()
+        assert ei.value.step_index == 2
+    finally:
+        entry.jitted = orig
+
+
+def test_async_xla_failure_names_step():
+    """Chaos-adjacent: the failure happens INSIDE the computation (host
+    callback raising for one specific step's `t`), not in dispatch
+    bookkeeping — it must still surface as PipelineStepError naming the
+    failing step at a materialization boundary."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    prog, loss, _ = _build("chaos_xla")
+    exe = static.Executor()
+    runner = PipelineRunner(exe, prog, fetch_list=[loss], max_inflight=8)
+    feeds = _feeds(5)
+    runner.submit(feeds[0])
+    entry = runner._entry
+    orig_step, orig_jit = entry.step_fn, entry.jitted
+
+    def host_check(t):
+        if int(t) == 3:  # optimizer tick t==3 <-> pipeline step index 2
+            raise RuntimeError("xla chaos at t=3")
+        return np.float32(0)
+
+    def wrapped(feed_tuple, scope_vals, slots, lr, t, key):
+        probe = io_callback(host_check,
+                            jax.ShapeDtypeStruct((), jnp.float32), t,
+                            ordered=True)
+        fetches, new_scope, new_slots = orig_step(
+            feed_tuple, scope_vals, slots, lr, t, key)
+        return tuple(f + probe.astype(f.dtype) for f in fetches), \
+            new_scope, new_slots
+
+    entry.jitted = jax.jit(wrapped, donate_argnums=entry.donate)
+    try:
+        for f in feeds[1:]:
+            runner.submit(f)
+        with pytest.raises(PipelineStepError, match="step 2"):
+            runner.sync()
+    finally:
+        entry.step_fn, entry.jitted = orig_step, orig_jit
+
+
+def test_executor_cache_uid_key_and_lru_bound():
+    saved = paddle.get_flags(["FLAGS_executor_cache_size"])
+    monitor.reset("executor/cache_evictions")
+    paddle.set_flags({"FLAGS_executor_cache_size": 2})
+    try:
+        exe = static.Executor()
+        progs = []
+        for i in range(3):
+            prog, loss, _ = _build(f"lru{i}")
+            progs.append((prog, loss))
+            exe.run(prog, feed=_feeds(1)[0], fetch_list=[loss])
+        assert len(exe._cache) == 2
+        assert monitor.stat_get("executor/cache_evictions") >= 1
+        # keys carry program.uid, never id(program) — id reuse after GC
+        # must not resolve to a stale entry
+        assert all(k[0] == p.uid for k, (p, _) in
+                   zip(list(exe._cache), progs[1:]))
+        # evicted program recompiles instead of stale-hitting
+        before = monitor.stat_get("executor/lowerings")
+        exe.run(progs[0][0], feed=_feeds(1)[0], fetch_list=[progs[0][1]])
+        assert monitor.stat_get("executor/lowerings") == before + 1
+    finally:
+        paddle.set_flags(saved)
+
+
+def test_feed_conversion_fast_path():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.static.executor import _convert_feed
+    aval = jax.ShapeDtypeStruct((4,), jnp.float32)
+    dev = jnp.ones((4,), jnp.float32)
+    assert _convert_feed(dev, aval) is dev  # no host round trip
+    out = _convert_feed(np.ones(4, np.float64), aval)
+    assert isinstance(out, jax.Array) and out.dtype == jnp.float32
+    wrong = jnp.ones((4,), jnp.int32)
+    assert _convert_feed(wrong, aval).dtype == jnp.float32
+
+
+def test_run_return_handles():
+    prog, loss, _ = _build("handles")
+    exe = static.Executor()
+    f = _feeds(1)[0]
+    (h,) = exe.run(prog, feed=f, fetch_list=[loss], return_handles=True)
+    assert isinstance(h, FetchHandle)
+    v = np.asarray(h)  # __array__ protocol
+    prog2, loss2, _ = _build("handles2")
+    exe2 = static.Executor()
+    (ref,) = exe2.run(prog2, feed=f, fetch_list=[loss2])
+    np.testing.assert_array_equal(v, np.asarray(ref))
+
+
+def test_pipeline_gauges_published():
+    _run_pipelined(5, 2, 0)
+    assert monitor.stat_get("executor/inflight_depth") >= 1
+    assert monitor.stat_get("executor/step_wall_ms") > 0
+    assert monitor.stat_get("executor/host_overhead_ms") >= 0
+
+
+def test_train_from_dataset_scan_fused_via_exec_strategy(capsys):
+    class _DS:
+        def batches(self):
+            yield from _feeds(6)
+
+    prog, loss, _ = _build("tfd_scan")
+    es = static.ExecutionStrategy()
+    es.scan_fuse_steps = 3
+    cp = static.CompiledProgram(prog, exec_strategy=es)
+    exe = static.Executor()
+    before = monitor.stat_get("executor/scan_megasteps")
+    exe.train_from_dataset(cp, _DS(), fetch_list=[loss], print_period=2)
+    assert monitor.stat_get("executor/scan_megasteps") == before + 2
+    out = capsys.readouterr().out
+    assert "batch 2:" in out and "batch 6:" in out
+
+
+def test_hapi_fit_window_defers_materialization():
+    """The async window must actually DELAY loss materialization (a
+    bitwise test can't see this): a non-boundary step's loss is read only
+    after later steps were submitted (window bound or log_freq drain)."""
+    paddle.disable_static()
+    from paddle_tpu.hapi import Model
+
+    log = []
+
+    class _LazyLoss:
+        def __init__(self, i):
+            self.i = i
+
+        def __array__(self, dtype=None, copy=None):
+            log.append(("mat", self.i))
+            return np.zeros((), "float32")
+
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    m = Model(net)
+    m.prepare(optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters()),
+              loss=nn.MSELoss())
+    counter = {"i": 0}
+
+    def fake_train_batch(inputs, labels, update=True):
+        i = counter["i"]
+        counter["i"] += 1
+        log.append(("submit", i))
+        return _LazyLoss(i), []
+
+    m._engine.train_batch = fake_train_batch
+    saved = paddle.get_flags(["FLAGS_executor_max_inflight"])
+    paddle.set_flags({"FLAGS_executor_max_inflight": 2})
+    try:
+        batches = [(np.zeros((2, 4), "float32"), np.zeros((2, 1),
+                                                          "float32"))] * 8
+        m.fit(batches, epochs=1, log_freq=4, verbose=0)
+    finally:
+        paddle.set_flags(saved)
+    # every loss materializes exactly once, in order
+    mats = [i for kind, i in log if kind == "mat"]
+    assert mats == list(range(8)), mats
+    # step 1's loss is NOT read in step 1's iteration: step 2 (and 3) are
+    # submitted first, then the log_freq=4 boundary drains 1..3
+    assert log.index(("submit", 2)) < log.index(("mat", 1)), log
+    assert log.index(("submit", 3)) < log.index(("mat", 1)), log
+
+
+def test_hapi_fit_async_matches_sync():
+    """Model.fit's async loss window (drained at log_freq boundaries)
+    must not change training: final weights bitwise-equal to the
+    synchronous per-step loop."""
+    paddle.disable_static()
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import Dataset
+
+    class _Reg(Dataset):
+        def __init__(self):
+            rng = np.random.RandomState(3)
+            self.x = rng.rand(32, 4).astype("float32")
+            self.y = rng.rand(32, 1).astype("float32")
+
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    def fit(inflight):
+        saved = paddle.get_flags(["FLAGS_executor_max_inflight"])
+        paddle.set_flags({"FLAGS_executor_max_inflight": inflight})
+        try:
+            paddle.seed(0)
+            net = nn.Linear(4, 1)
+            m = Model(net)
+            m.prepare(optimizer.Adam(learning_rate=0.05,
+                                     parameters=net.parameters()),
+                      loss=nn.MSELoss())
+            m.fit(_Reg(), batch_size=8, epochs=2, shuffle=False,
+                  log_freq=3, verbose=0)
+            return [np.asarray(p) for p in net.parameters()]
+        finally:
+            paddle.set_flags(saved)
+
+    sync_w = fit(0)
+    async_w = fit(2)
+    for a, b in zip(sync_w, async_w):
+        np.testing.assert_array_equal(a, b)

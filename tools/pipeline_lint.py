@@ -1,0 +1,123 @@
+"""Async-executor pipeline config lint (framework_lint cross-check).
+
+Owns the canonical train-mode pipeline bench config (PIPELINE_CFG) and
+checks, without running anything expensive, that the three places it is
+encoded cannot drift apart:
+
+1. bench.py's BENCH_PIPE_* env-var defaults (the measured evidence),
+2. core/flags.py FLAGS_executor_* declared defaults (the runtime
+   behavior every training loop actually gets), and
+3. tools/hlo_evidence.py's scan-megastep evidence config (the lowered
+   proof that K steps become one computation).
+
+Registered in tools/framework_lint.py TOOL_CROSS_CHECKS, so tier-1 runs
+it on every change (tests/test_framework_lint.py).
+
+Usage:
+  python tools/pipeline_lint.py          # standalone; exit 1 on drift
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS_DIR)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# canonical train-mode pipeline bench config (bench.py bench_pipeline)
+PIPELINE_CFG = {"batch": 256, "hidden": 64, "steps": 200, "scan_k": 8,
+                "inflight": 2}
+TINY_PIPELINE_CFG = {"batch": 8, "hidden": 4, "steps": 8, "scan_k": 4,
+                     "inflight": 2}
+
+
+def _bench_source():
+    with open(os.path.join(REPO, "bench.py")) as f:
+        return f.read()
+
+
+def self_check():
+    problems = []
+    src = _bench_source()
+
+    def bench_default(env, want):
+        m = re.search(r'os\.environ\.get\("%s",\s*([0-9]+)\)' % env, src)
+        if not m:
+            problems.append(
+                f"pipeline_lint: bench.py no longer reads {env}")
+            return
+        if int(m.group(1)) != want:
+            problems.append(
+                f"pipeline_lint: bench.py default {env}={m.group(1)} but "
+                f"PIPELINE_CFG says {want} — update the canonical config")
+
+    bench_default("BENCH_PIPE_BATCH", PIPELINE_CFG["batch"])
+    bench_default("BENCH_PIPE_HIDDEN", PIPELINE_CFG["hidden"])
+    bench_default("BENCH_PIPE_STEPS", PIPELINE_CFG["steps"])
+    bench_default("BENCH_PIPE_SCAN_K", PIPELINE_CFG["scan_k"])
+    bench_default("BENCH_PIPE_INFLIGHT", PIPELINE_CFG["inflight"])
+
+    # flag DECLARED defaults (not live values — a test may have set them)
+    try:
+        from paddle_tpu.core import flags as _flags
+        defs = _flags._DEFS
+    except Exception as e:
+        return problems + [f"pipeline_lint: flags import failed: {e!r}"]
+    for name in ("FLAGS_executor_max_inflight", "FLAGS_executor_scan_steps",
+                 "FLAGS_executor_cache_size"):
+        if name not in defs:
+            problems.append(f"pipeline_lint: flag {name} is gone but the "
+                            "pipeline runner / bench still depend on it")
+    if "FLAGS_executor_max_inflight" in defs and \
+            int(defs["FLAGS_executor_max_inflight"][1]) != \
+            PIPELINE_CFG["inflight"]:
+        problems.append(
+            "pipeline_lint: FLAGS_executor_max_inflight default "
+            f"{defs['FLAGS_executor_max_inflight'][1]} != bench inflight "
+            f"{PIPELINE_CFG['inflight']} — the bench would measure a "
+            "pipeline depth users don't get by default")
+    if "FLAGS_executor_scan_steps" in defs and \
+            int(defs["FLAGS_executor_scan_steps"][1]) != 0:
+        problems.append(
+            "pipeline_lint: FLAGS_executor_scan_steps default must stay 0 "
+            "(scan fusion is opt-in; docs/async_executor.md) — bench/"
+            "evidence pass K explicitly")
+
+    # hlo_evidence keeps an INDEPENDENT literal of this config for its
+    # scan-megastep section (importing ours here would make this check
+    # compare an object against itself)
+    try:
+        if TOOLS_DIR not in sys.path:
+            sys.path.insert(0, TOOLS_DIR)
+        import hlo_evidence
+        if getattr(hlo_evidence, "PIPELINE_CFG", None) != PIPELINE_CFG:
+            problems.append(
+                "pipeline_lint: tools/hlo_evidence.py PIPELINE_CFG "
+                f"{getattr(hlo_evidence, 'PIPELINE_CFG', None)} != "
+                f"{PIPELINE_CFG} — the lowered scan evidence no longer "
+                "matches the measured bench config")
+        if PIPELINE_CFG["scan_k"] < 2:
+            problems.append(
+                "pipeline_lint: scan_k must be >= 2 — the '>=2x fewer "
+                "dispatches per K steps' acceptance bar is vacuous below "
+                "that")
+    except Exception as e:
+        problems.append(f"pipeline_lint: hlo_evidence import failed: "
+                        f"{e!r}")
+    return problems
+
+
+def main(argv=None):
+    problems = self_check()
+    for p in problems:
+        print(p)
+    print("pipeline_lint:",
+          "clean" if not problems else f"{len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
