@@ -657,24 +657,47 @@ def _probe_backend(timeout_s):
     print(f"# devices: {done['devices']}", file=sys.stderr, flush=True)
 
 
+def _emit_metrics_snapshot(mode):
+    """One `{mode}_metrics_snapshot` line per bench mode: the full typed
+    monitor snapshot (counters/gauges/histograms — executor pipeline
+    gauges, pallas engagement, ps health), so BENCH_*.json carries the
+    counters behind the perf numbers, not just the numbers
+    (tools/obs_report.py self_check pins this emission)."""
+    try:
+        from paddle_tpu.core import monitor
+        snap = monitor.snapshot(include_series=False)
+        print(json.dumps({"metric": f"{mode}_metrics_snapshot",
+                          "value": len(snap["values"]),
+                          "unit": "metrics", "monitor": snap},
+                         default=str), flush=True)
+    except Exception as e:  # additive evidence; never block perf lines
+        print(f"# metrics snapshot failed for {mode}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+
+
 def main():
     _probe_backend(float(os.environ.get("BENCH_INIT_TIMEOUT", 600)))
     mode = os.environ.get("BENCH_MODE", "all")
     if mode in ("bert", "all"):
         bench_bert()          # flagship: FIRST stdout line
+        _emit_metrics_snapshot("bert")
     if mode in ("resnet", "all"):
         bench_resnet()
+        _emit_metrics_snapshot("resnet")
     if mode in ("decode", "all"):
         bench_decode()
+        _emit_metrics_snapshot("decode")
     if mode in ("longseq", "all"):
         try:
             bench_longseq()
+            _emit_metrics_snapshot("longseq")
         except Exception as e:  # long-seq is additive evidence; never
             print(f"# longseq bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)  # block the primary lines
     if mode in ("pipeline", "all"):
         try:
             bench_pipeline()
+            _emit_metrics_snapshot("pipeline")
         except Exception as e:  # additive evidence line, never blocking
             print(f"# pipeline bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)

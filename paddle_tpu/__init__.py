@@ -36,6 +36,11 @@ from . import device  # noqa: F401
 from .device import (CPUPlace, CUDAPlace, TPUPlace, get_device,  # noqa: F401
                      set_device, is_compiled_with_cuda)
 
+# flight recorder: arm the fatal-signal dump hook when a dump dir is
+# configured (PADDLE_TPU_DUMP_DIR); a pure no-op otherwise
+from .core import flight_recorder as _flight_recorder
+_flight_recorder.maybe_install()
+
 
 def in_dynamic_mode():
     try:

@@ -159,6 +159,20 @@ define_flag("FLAGS_executor_cache_size", 32,
             "keyed on program.uid + feed/fetch signature); evictions bump "
             "executor/cache_evictions in core/monitor")
 
+# --- observability (core/trace.py, core/monitor.py, flight recorder) ----
+define_flag("FLAGS_trace_ring_size", 4096,
+            "bounded ring of recent finished spans kept by the always-on "
+            "tracer (core/trace.py) — the flight recorder's feed: on "
+            "PipelineStepError / PS transport death / fatal signal the "
+            "last N spans are dumped to PADDLE_TPU_DUMP_DIR. 0 disables "
+            "the bound (unbounded ring; tests only). Runtime set_flags "
+            "changes apply at the next trace.start()/reset() — call "
+            "trace.set_ring_size() to resize immediately")
+define_flag("FLAGS_monitor_series_len", 256,
+            "per-metric bounded time-series ring in core/monitor: every "
+            "stat_add/stat_set/observe appends (unix_ts, value) so dumps "
+            "and dashboards see a trajectory, not just the final value")
+
 # --- PS transport fault tolerance (distributed/ps/rpc.py) ---------------
 # The reference's brpc channel exposes the same three knobs
 # (connect_timeout_ms / timeout_ms / max_retry in brpc_ps_client.cc);

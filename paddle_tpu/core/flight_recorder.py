@@ -1,0 +1,214 @@
+"""Always-on flight recorder: dump recent spans + metrics on failure.
+
+The span ring (core/trace.py) and the metric registry (core/monitor.py)
+are always recording; this module turns them into a post-mortem artifact.
+When `PADDLE_TPU_DUMP_DIR` is set, a failure writes one self-contained
+JSON dump there:
+
+- `PipelineStepError` (an in-flight async step failed —
+  static/pipeline_runner.py raises at the materialization boundary),
+- PS transport death (retry budget exhausted: DeadlineExceeded /
+  ConnectionError out of distributed/ps/rpc.py, or the Communicator send
+  thread dying),
+- a fatal signal (SIGTERM by default; SIGUSR1 dumps on demand without
+  killing the process) when `maybe_install()` ran at import.
+
+Render a dump with `python tools/obs_report.py <dump.json>`: per-step
+timeline, host-overhead breakdown, PS health, Pallas fallback rates.
+
+With `PADDLE_TPU_DUMP_DIR` unset every hook is a no-op — the recorder
+costs one env lookup on the failure path and nothing in steady state.
+Dumps are rate-limited per reason so a failure storm (every handle of a
+broken pipeline raising) cannot fill a disk.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from collections import defaultdict
+
+from . import monitor as _monitor
+from . import trace as _trace
+
+__all__ = ["dump", "dump_dir", "enabled", "suppressed", "maybe_install",
+           "install_signal_handlers", "SCHEMA_VERSION", "SCHEMA_KEYS"]
+
+SCHEMA_VERSION = 1
+# tools/obs_report.py renders exactly these sections; its self_check()
+# (registered in tools/framework_lint.py TOOL_CROSS_CHECKS) pins the two
+# against each other so the dump format and the renderer cannot drift.
+SCHEMA_KEYS = ("schema", "reason", "time", "pid", "argv", "exception",
+               "spans", "metrics", "flags", "env", "extra")
+
+_lock = threading.Lock()
+_dumped = defaultdict(int)
+_seq = 0
+MAX_DUMPS_PER_REASON = 4
+
+_prev_handlers: dict = {}
+
+
+def dump_dir() -> str:
+    return os.environ.get("PADDLE_TPU_DUMP_DIR", "")
+
+
+def enabled() -> bool:
+    return bool(dump_dir())
+
+
+_suppress_tls = threading.local()
+
+
+@contextlib.contextmanager
+def suppressed(reason: str):
+    """Suppress `reason` dumps on THIS thread for the scope — for outer
+    retry layers whose inner layer would otherwise declare death
+    prematurely (the Communicator rides out per-call retry exhaustion on
+    all but its last send attempt)."""
+    active = getattr(_suppress_tls, "reasons", None)
+    if active is None:
+        active = _suppress_tls.reasons = set()
+    novel = reason not in active
+    if novel:
+        active.add(reason)
+    try:
+        yield
+    finally:
+        if novel:
+            active.discard(reason)
+
+
+def _is_suppressed(reason: str) -> bool:
+    return reason in getattr(_suppress_tls, "reasons", ())
+
+
+def _exception_record(exc):
+    if exc is None:
+        return None
+    tb = None
+    if getattr(exc, "__traceback__", None) is not None:
+        tb = "".join(traceback.format_exception(
+            type(exc), exc, exc.__traceback__))
+    return {"type": type(exc).__name__, "message": str(exc),
+            "traceback": tb}
+
+
+def _flags_snapshot():
+    try:
+        from . import flags as _flags
+        with _flags._LOCK:
+            return dict(_flags._REGISTRY)
+    except Exception:
+        return {}
+
+
+def record(reason: str, exc=None, extra=None) -> dict:
+    """The dump payload (also used by obs_report --live). Key set is
+    SCHEMA_KEYS, schema version SCHEMA_VERSION."""
+    return {
+        "schema": SCHEMA_VERSION,
+        "reason": reason,
+        "time": time.time(),
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+        "exception": _exception_record(exc),
+        # ring (finished) + this thread's still-open spans — the span
+        # enclosing the failure hasn't ended yet and would otherwise be
+        # the one span missing from its own post-mortem
+        "spans": [_trace.span_dict(s) for s in _trace.recent()]
+                 + [dict(_trace.span_dict(s), open=True)
+                    for s in _trace.open_spans()],
+        "metrics": _monitor.snapshot(),
+        "flags": _flags_snapshot(),
+        "env": {k: v for k, v in os.environ.items()
+                if k.startswith(("PADDLE_", "FLAGS_", "JAX_"))},
+        "extra": extra or {},
+    }
+
+
+def dump(reason: str, exc=None, extra=None):
+    """Write a flight-recorder dump; returns the path, or None when
+    disabled/rate-limited. NEVER raises — a recorder failure must not
+    mask the failure being recorded."""
+    try:
+        d = dump_dir()
+        if not d or _is_suppressed(reason):
+            return None
+        global _seq
+        with _lock:
+            if _dumped[reason] >= MAX_DUMPS_PER_REASON:
+                return None
+            _dumped[reason] += 1
+            _seq += 1
+            seq = _seq
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d, f"obsdump_{reason}_{os.getpid()}_{seq:03d}.json")
+        payload = record(reason, exc=exc, extra=extra)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, default=str)
+        os.replace(tmp, path)
+        return path
+    except Exception:
+        return None
+
+
+# -- fatal-signal hook -------------------------------------------------------
+
+def _handler(signum, frame):
+    # Python delivers signals on the MAIN thread between bytecodes — the
+    # interrupted code may be holding monitor/trace/flags locks (the hot
+    # loop bumps counters constantly), and those are not reentrant. A
+    # dump from the handler itself could deadlock on them; a side thread
+    # either gets the locks when their holders release, or we give up at
+    # the timeout and die dump-less. Best-effort by design.
+    th = threading.Thread(
+        target=dump, args=(f"signal_{signal.Signals(signum).name}",),
+        daemon=True)
+    th.start()
+    th.join(timeout=10.0)
+    prev = _prev_handlers.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+    elif signum != signal.SIGUSR1 and prev != signal.SIG_IGN:
+        # SIG_DFL — or None, i.e. a handler installed outside Python we
+        # cannot call: restore the default disposition and re-raise so
+        # the process still DIES on a fatal signal (a dump hook must
+        # never make SIGTERM a no-op for the supervisor)
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def install_signal_handlers(signals=(signal.SIGTERM, signal.SIGUSR1)):
+    """Chain a dump in front of the current handlers. SIGUSR1 becomes an
+    on-demand dump (process keeps running); SIGTERM dumps then defers to
+    whatever was installed (e.g. hapi's PreemptionGuard) or the default
+    disposition. Main-thread only (CPython restriction) — silently
+    no-ops elsewhere."""
+    installed = []
+    for sig in signals:
+        try:
+            prev = signal.signal(sig, _handler)
+        except (ValueError, OSError):
+            continue  # non-main thread or unsupported signal
+        if prev is not _handler:
+            _prev_handlers[sig] = prev
+        installed.append(sig)
+    return installed
+
+
+def maybe_install():
+    """Called from paddle_tpu import: arm the signal hook only when the
+    dump dir is configured (and PADDLE_TPU_DUMP_ON_SIGNAL isn't 0)."""
+    if not enabled():
+        return []
+    if os.environ.get("PADDLE_TPU_DUMP_ON_SIGNAL", "1") in ("0", "false"):
+        return []
+    return install_signal_handlers()

@@ -23,6 +23,7 @@ import zlib
 import numpy as np
 
 from ...core import monitor as _monitor
+from ...core import trace as _trace
 from ...core.flags import flag as _flag
 from .rpc import Connection
 
@@ -261,6 +262,11 @@ class Communicator:
                     first_ts = None
         except BaseException as e:  # noqa: BLE001 — re-raised to callers
             self._error = e
+            # the send thread is the PS stack's pulse: its death is a
+            # transport death — flight-record the span/metric history
+            # (no-op unless PADDLE_TPU_DUMP_DIR is set)
+            from ...core import flight_recorder as _fr
+            _fr.dump("ps_communicator_death", e)
             # NOTE: _send_merged's finally already task_done'd `pending`;
             # only drain what's still queued so flush() raises instead of
             # timing out (double-accounting raises 'task_done called too
@@ -282,9 +288,20 @@ class Communicator:
         attempts = int(_flag("PADDLE_PS_SEND_RETRIES")) + 1
         backoff = float(_flag("PADDLE_PS_BACKOFF_BASE_S"))
         ceiling = float(_flag("PADDLE_PS_BACKOFF_MAX_S"))
+        from ...core import flight_recorder as _fr
         for attempt in range(attempts):
             try:
-                self._send_merged(items, key)
+                with _trace.span("ps.comm/send_batch", items=len(items),
+                                 batch_no=self._batch_no,
+                                 attempt=attempt):
+                    if attempt < attempts - 1:
+                        # this layer will retry: an inner per-call
+                        # exhaustion is not yet transport death — only
+                        # the LAST attempt may declare it
+                        with _fr.suppressed("ps_transport_death"):
+                            self._send_merged(items, key)
+                    else:
+                        self._send_merged(items, key)
                 return
             except OSError:
                 # ConnectionError / DeadlineExceeded / FrameError — the

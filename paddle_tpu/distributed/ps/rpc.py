@@ -53,6 +53,7 @@ import uuid
 from collections import OrderedDict
 
 from ...core import monitor as _monitor
+from ...core import trace as _trace
 from ...core.flags import flag as _flag
 
 __all__ = ["send_msg", "recv_msg", "Connection", "serve", "FrameError",
@@ -297,6 +298,33 @@ class Connection:
         exactly-once too; `_timeout` overrides the per-attempt deadline
         (barriers legitimately block longer than data calls)."""
         timeout = self._timeout if _timeout is None else float(_timeout)
+        # one span per logical CALL (not per attempt): its context rides
+        # in the frame — which is packed once, so every retry/resend
+        # carries the SAME trace id and the server's apply/replay spans
+        # correlate with this call across the process boundary
+        sp = _trace.begin(f"ps.rpc/{method}", endpoint=self.endpoint,
+                          mutating=bool(_mutating))
+        t0 = time.perf_counter()
+        try:
+            result = self._call_impl(sp, method, _mutating, _key, timeout,
+                                     kwargs)
+            _monitor.observe("ps.rpc/latency_ms",
+                             (time.perf_counter() - t0) * 1e3)
+            return result
+        except BaseException as e:
+            sp.attrs.setdefault("error", type(e).__name__)
+            _trace.end(sp)   # record BEFORE the dump snapshots the ring
+            extra = getattr(e, "_flight_extra", None)
+            if extra is not None:
+                # retry budget exhausted: the transport is dead for this
+                # call — flight-record the span/metric history
+                from ...core import flight_recorder as _fr
+                _fr.dump("ps_transport_death", e, extra=extra)
+            raise
+        finally:
+            _trace.end(sp)
+
+    def _call_impl(self, sp, method, _mutating, _key, timeout, kwargs):
         req = {"method": method, **kwargs}
         with self._lock:
             if _mutating:
@@ -304,6 +332,7 @@ class Connection:
                     self._seq += 1
                     _key = self._seq
                 req["__rid__"] = (self._client_id, _key)
+            req["__trace__"] = sp.context
             # pack ONCE, outside the retry loop: an oversized request is
             # a deterministic local error (no retry, nothing hit the
             # wire), and resends reuse the bytes instead of re-pickling
@@ -315,6 +344,7 @@ class Connection:
                     f"is {len(payload)} bytes "
                     f"(PADDLE_PS_MAX_FRAME={limit})")
             frame = _HDR.pack(len(payload)) + payload
+            _monitor.stat_add("ps.rpc.bytes_out", len(frame))
             attempts = self._max_retries + 1
             last_err = None
             for attempt in range(attempts):
@@ -344,20 +374,27 @@ class Connection:
                     last_err = e
                     self._teardown()
                     continue
+                sp.attrs["attempts"] = attempt + 1
                 if reply.get("error"):
                     raise RuntimeError(f"ps server error in {method!r}: "
                                        f"{reply['error']}")
                 return reply.get("result")
+        # retry budget exhausted: tag the exception so call() writes a
+        # flight-recorder dump AFTER the span lands in the ring
+        sp.attrs["attempts"] = attempts
         if isinstance(last_err, TimeoutError):
             _monitor.stat_add("ps.rpc.deadline_exceeded")
-            raise DeadlineExceeded(
+            err = DeadlineExceeded(
                 f"ps rpc deadline exceeded calling {method!r} on "
                 f"{self.endpoint}: {attempts} attempts of {timeout:.1f}s "
-                "each (PADDLE_PS_CALL_TIMEOUT / PADDLE_PS_MAX_RETRIES)"
-            ) from last_err
-        raise ConnectionError(
-            f"ps rpc failed calling {method!r} on {self.endpoint} after "
-            f"{attempts} attempts: {last_err}") from last_err
+                "each (PADDLE_PS_CALL_TIMEOUT / PADDLE_PS_MAX_RETRIES)")
+        else:
+            err = ConnectionError(
+                f"ps rpc failed calling {method!r} on {self.endpoint} "
+                f"after {attempts} attempts: {last_err}")
+        err._flight_extra = {"method": method, "endpoint": self.endpoint,
+                             "attempts": attempts}
+        raise err from last_err
 
     def ping(self, timeout=None):
         """Transport liveness probe; served by the peer before auth, so
@@ -442,6 +479,18 @@ class ReplayCache:
         return None
 
 
+def _trace_ctx_of(req):
+    """Pop the client-shipped trace context (trace_id, span_id) from a
+    request, validating shape — a peer without the tracer (or a garbled
+    field) degrades to a fresh local trace, never an error."""
+    ctx = req.pop("__trace__", None)
+    try:
+        trace_id, span_id = ctx
+        return (str(trace_id), None if span_id is None else str(span_id))
+    except (TypeError, ValueError):
+        return None
+
+
 def _rid_of(req):
     rid = req.pop("__rid__", None)
     if rid is None:
@@ -479,34 +528,48 @@ def serve(endpoint: str, handler, stop_event: threading.Event):
     def _serve_one(conn, method, req):
         """Run the handler (through the replay cache when the request is
         stamped) and send the reply, honoring injected reply faults.
-        Returns False when the connection must close."""
+        Returns False when the connection must close. The span parents to
+        the trace context the CLIENT shipped in the frame (same bytes on
+        every retry), so apply AND replay spans of one logical call share
+        its trace id across the process boundary."""
+        tctx = _trace_ctx_of(req)
         rid = _rid_of(req)
-        reply = None
-        if rid is not None:
-            state, payload = replay.begin(rid)
-            if state == "replay":
-                _monitor.stat_add("ps.rpc.replays")
-                reply = payload
-            elif state == "wait":
-                # the original attempt is still executing on another
-                # connection thread — parking beats double-applying
-                payload.wait(timeout=600.0)
-                reply = replay.lookup(rid)
-                if reply is None:
-                    reply = {"error": "ps rpc: in-flight original never "
-                                      "committed (server overloaded?)"}
-                else:
-                    _monitor.stat_add("ps.rpc.replays")
-        if reply is None:
-            try:
-                result = handler(method, req)
-                reply = {"result": result}
-            except Exception as e:  # noqa: BLE001 — reported to peer
-                reply = {"error": f"{type(e).__name__}: {e}"}
+        sp = _trace.begin(f"ps.server/{method}", parent=tctx,
+                          outcome="apply")
+        try:
+            reply = None
             if rid is not None:
-                # commit BEFORE the reply leaves: if the response is lost
-                # from here on, the retry replays instead of re-applying
-                replay.commit(rid, reply)
+                state, payload = replay.begin(rid)
+                if state == "replay":
+                    _monitor.stat_add("ps.rpc.replays")
+                    sp.attrs["outcome"] = "replay"
+                    reply = payload
+                elif state == "wait":
+                    # the original attempt is still executing on another
+                    # connection thread — parking beats double-applying
+                    sp.attrs["outcome"] = "wait"
+                    payload.wait(timeout=600.0)
+                    reply = replay.lookup(rid)
+                    if reply is None:
+                        reply = {"error": "ps rpc: in-flight original "
+                                          "never committed (server "
+                                          "overloaded?)"}
+                    else:
+                        _monitor.stat_add("ps.rpc.replays")
+            if reply is None:
+                try:
+                    result = handler(method, req)
+                    reply = {"result": result}
+                except Exception as e:  # noqa: BLE001 — reported to peer
+                    sp.attrs["error"] = type(e).__name__
+                    reply = {"error": f"{type(e).__name__}: {e}"}
+                if rid is not None:
+                    # commit BEFORE the reply leaves: if the response is
+                    # lost from here on, the retry replays instead of
+                    # re-applying
+                    replay.commit(rid, reply)
+        finally:
+            _trace.end(sp)
         try:
             act = _fault("server", "reply", method)
         except ConnectionError:

@@ -24,6 +24,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
+from ..core import trace as _trace
 from ..core.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
@@ -123,8 +124,14 @@ class DataLoader:
         stop = threading.Event()
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor * self.num_workers)
 
+        parent_ctx = _trace.current()
+
         def fetch(indices):
-            return self.collate_fn([self.dataset[i] for i in indices])
+            # worker-pool span: joins the loader's ambient trace so a
+            # slow transform shows up next to the step that starved
+            with _trace.attach(parent_ctx), \
+                    _trace.span("io/collate", n=len(indices)):
+                return self.collate_fn([self.dataset[i] for i in indices])
 
         def producer():
             try:
@@ -229,19 +236,40 @@ class DataLoader:
         sentinel = object()
         stop = threading.Event()
         err = []
+        parent_ctx = _trace.current()
+
+        def _next_batch(it, seq):
+            # spans the PRODUCTION of one batch (collate/worker wait),
+            # the host-side cost the double-buffer exists to hide
+            sp = _trace.begin("io/produce_batch", seq=seq)
+            try:
+                return next(it)
+            except StopIteration:
+                _trace.end(sp, discard=True)
+                raise
+            finally:
+                if sp.t1 is None:
+                    _trace.end(sp)
 
         def producer():
             try:
-                for b in gen:
-                    while not stop.is_set():
+                with _trace.attach(parent_ctx):
+                    it, seq = iter(gen), 0
+                    while True:
                         try:
-                            q.put(b, timeout=0.1)
+                            b = _next_batch(it, seq)
+                        except StopIteration:
                             break
-                        except queue.Full:
-                            continue
-                    if stop.is_set():
-                        gen.close() if hasattr(gen, "close") else None
-                        return
+                        seq += 1
+                        while not stop.is_set():
+                            try:
+                                q.put(b, timeout=0.1)
+                                break
+                            except queue.Full:
+                                continue
+                        if stop.is_set():
+                            gen.close() if hasattr(gen, "close") else None
+                            return
             except BaseException as e:  # propagate to consumer
                 err.append(e)
             finally:

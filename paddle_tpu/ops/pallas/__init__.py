@@ -38,8 +38,9 @@ __all__ = ["flash_attention", "decode_attention", "run_guarded",
 def gate_reject(kernel: str, reason: str):
     """Record one eligibility-gate rejection (and return False so gates
     can `return gate_reject(k, r)`)."""
-    from ...core import monitor
+    from ...core import monitor, trace
     monitor.stat_add(f"pallas.gate_reject.{kernel}.{reason}")
+    trace.instant("pallas/gate_reject", kernel=kernel, reason=reason)
     return False
 
 
@@ -47,13 +48,23 @@ def run_guarded(kernel: str, thunk, fallback):
     """Run a Pallas kernel thunk; on ANY failure demote to the jnp
     fallback thunk, bumping pallas.fallback.{kernel}.{exception-type}.
     FLAGS_pallas_strict re-raises instead (kernel development / tests
-    that assert on the error itself)."""
+    that assert on the error itself). Every dispatch leaves a span with
+    its outcome (hit / fallback+reason) in the trace ring, so a fallback
+    storm shows up in a flight-recorder dump with per-call timing, not
+    just a final counter value."""
     from ...core import flags as _flags
-    from ...core import monitor
+    from ...core import monitor, trace
+    sp = trace.begin(f"pallas/{kernel}")
     try:
         out = thunk()
     except Exception as e:
-        if _flags.flag("FLAGS_pallas_strict"):
+        strict = _flags.flag("FLAGS_pallas_strict")
+        # strict mode re-raises without running the fallback — the span
+        # must not claim a fallback the counters won't show
+        sp.attrs["outcome"] = "error" if strict else "fallback"
+        sp.attrs["reason"] = type(e).__name__
+        trace.end(sp)
+        if strict:
             raise
         monitor.stat_add(f"pallas.fallback.{kernel}.{type(e).__name__}")
         warnings.warn(
@@ -62,5 +73,7 @@ def run_guarded(kernel: str, thunk, fallback):
             "monitor.stats('pallas.') and docs/pallas_kernels.md.",
             RuntimeWarning, stacklevel=2)
         return fallback()
+    sp.attrs["outcome"] = "hit"
+    trace.end(sp)
     monitor.stat_add(f"pallas.hit.{kernel}")
     return out

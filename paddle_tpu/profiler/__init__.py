@@ -28,6 +28,8 @@ import time
 from collections import defaultdict
 from typing import Optional
 
+from ..core import trace as _trace
+
 __all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
            "reset_profiler", "summary", "events", "export_chrome_trace",
            "xplane_trace", "start_xplane", "stop_xplane", "cost_analysis",
@@ -36,38 +38,54 @@ __all__ = ["RecordEvent", "profiler", "start_profiler", "stop_profiler",
 _lock = threading.Lock()
 _events: list = []          # (name, t0, t1, tid)
 _enabled = False
-_t_origin = time.perf_counter()
+_t_origin = _trace._t_origin  # shared clock origin with the span tracer
 
 
 def is_profiler_enabled() -> bool:
     return _enabled
 
 
-class RecordEvent:
-    """Scoped host annotation (reference platform/profiler.h:127).
+def _trace_sink(sp):
+    """Installed into core/trace: while the host profiler is enabled,
+    every finished span (RecordEvent or first-class trace.span site —
+    pipeline runner, PS rpc, Pallas dispatch, dataloader) also lands in
+    the profiler's aggregate event table, so summary() covers the whole
+    runtime without double instrumentation."""
+    if _enabled:
+        with _lock:
+            _events.append((sp.name, sp.t0, sp.t1, sp.tid))
 
-    Usable as a context manager or via explicit begin()/end(). Cheap no-op
-    while the profiler is disabled.
+
+_trace._profiler_sink = _trace_sink
+
+
+class RecordEvent:
+    """Scoped host annotation (reference platform/profiler.h:127), now a
+    thin wrapper over a core/trace span: it nests under the ambient span
+    and shows up in Chrome-trace exports with ids/parents. Usable as a
+    context manager or via explicit begin()/end(). Cheap no-op while the
+    profiler is disabled (per-op sites in core/tape.py stay free); use
+    core.trace.span directly for always-on (flight-recorded) sites.
     """
 
-    __slots__ = ("name", "_t0")
+    __slots__ = ("name", "_span")
 
     def __init__(self, name: str):
         self.name = name
-        self._t0 = None
+        self._span = None
 
     def begin(self):
         if _enabled:
-            self._t0 = time.perf_counter()
+            # detached: legacy callers (core/tape.py per-op annotations)
+            # skip end() on exception — a stack-attached span would then
+            # corrupt every later span's parentage on this thread
+            self._span = _trace.begin(self.name, _attach=False)
         return self
 
     def end(self):
-        if self._t0 is not None:
-            t1 = time.perf_counter()
-            with _lock:
-                _events.append((self.name, self._t0, t1,
-                                threading.get_ident()))
-            self._t0 = None
+        if self._span is not None:
+            _trace.end(self._span)  # the sink mirrors it into _events
+            self._span = None
 
     __enter__ = begin
 

@@ -206,16 +206,21 @@ class Executor:
         if entry is not None:
             self._cache.move_to_end(key)
             return entry
-        from .. import profiler as _prof
         from ..core import flags as _flags0
         from ..core import monitor as _monitor
+        from ..core import trace as _trace
         # PADDLE_TPU_VERIFY_SPMD: sharding findings (unbound axis,
         # non-divisible dim, implied reshard, ...) fail HERE — before
         # jit tracing, where they would surface as silent replication
         # or an opaque XLA error (mirrors PADDLE_TPU_VERIFY_PASSES)
         from .spmd_analyzer import maybe_verify_spmd
         spmd_rep = maybe_verify_spmd(program)
-        with _prof.RecordEvent("executor/lower_program"):
+        # always-on span (absorbs the old RecordEvent annotation): a
+        # compile on the hot path is exactly what a flight-recorder dump
+        # needs to show
+        with _trace.span("executor/lower_program", program=program.name,
+                         ops=len(program.ops),
+                         data_parallel=bool(data_parallel)):
             entry = self._compile(program, sorted(feed_vals), fetch_ids,
                                   data_parallel)
         self._cache[key] = entry
@@ -290,9 +295,9 @@ class Executor:
 
         from ..core import rng as _rng
         from ..core import monitor as _monitor
-        from .. import profiler as _prof
+        from ..core import trace as _trace
         _monitor.stat_add("executor/runs")
-        with _prof.RecordEvent("executor/run_step"):
+        with _trace.span("executor/run_step", program=program.name):
             fetches, new_scope, new_slots = entry.jitted(
                 tuple(feed_vals[n] for n in entry.feed_names), scope_vals,
                 slots, lr, t, _rng.next_key())

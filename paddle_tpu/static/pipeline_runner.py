@@ -43,6 +43,7 @@ scan_megasteps}`.
 """
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -52,12 +53,24 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import trace as _trace
+
 __all__ = ["PipelineRunner", "FetchHandle", "PipelineStepError"]
+
+# Flow-id namespace: each runner gets a disjoint block so step flows from
+# two runners in one process can't alias in the Chrome trace. Step idx
+# rides in the low 40 bits (no aliasing until ~10^12 steps); bit 41
+# marks prefetch->dispatch flows. Python ints are unbounded and Chrome
+# takes 64-bit ids, so the wide layout costs nothing.
+_FLOW_NS = itertools.count(1)
 
 
 class PipelineStepError(RuntimeError):
     """An in-flight step failed; raised at the materialization boundary
-    that first observed it, naming the failing step index."""
+    that first observed it, naming the failing step index. Constructing
+    one triggers a flight-recorder dump (recent spans + metrics to
+    PADDLE_TPU_DUMP_DIR; no-op when unset) — the failure was in flight,
+    so the dump is the only timeline of what the pipeline was doing."""
 
     def __init__(self, step_index, original, last_index=None):
         self.step_index = step_index
@@ -68,6 +81,10 @@ class PipelineStepError(RuntimeError):
             f"pipelined {which} failed: "
             f"{type(original).__name__}: {original}")
         self.original = original
+        from ..core import flight_recorder as _fr
+        _fr.dump("pipeline_step_error", original,
+                 extra={"step_index": step_index,
+                        "last_index": self.last_index})
 
 
 class FetchHandle:
@@ -87,17 +104,29 @@ class FetchHandle:
         return self._index
 
     def numpy(self):
+        sp = _trace.begin(
+            "pipeline/materialize", step=self._index,
+            parent=None if self._runner is None
+            else self._runner._trace_ctx)
         if self._runner is not None:
-            self._runner._verify_through(self._index)
-        if self._value is None:  # dispatch was skipped: pipeline broken
-            raise PipelineStepError(
-                self._index,
-                RuntimeError("step was never dispatched (an earlier "
-                             "in-flight step already failed)"))
+            sp.flow(self._runner._flow_base + self._index, "f")
         try:
-            arr = np.asarray(self._value)
-        except Exception as e:
-            raise PipelineStepError(self._index, e) from e
+            if self._runner is not None:
+                self._runner._verify_through(self._index)
+            if self._value is None:  # dispatch was skipped: pipeline broken
+                raise PipelineStepError(
+                    self._index,
+                    RuntimeError("step was never dispatched (an earlier "
+                                 "in-flight step already failed)"))
+            try:
+                arr = np.asarray(self._value)
+            except Exception as e:
+                raise PipelineStepError(self._index, e) from e
+        except BaseException as e:
+            sp.attrs["error"] = type(e).__name__
+            raise
+        finally:
+            _trace.end(sp)
         if self._row is not None:  # np scalar -> 0-d ndarray for __array__
             arr = np.asarray(arr[self._row])
         from ..core import flags as _flags
@@ -163,6 +192,15 @@ class PipelineRunner:
         self._host_s = 0.0
         self._wall_t0 = None
         self._depth_peak = 0
+        # disjoint flow-id block for this runner's step flows (s: dispatch,
+        # t: retire, f: materialize) and prefetch->dispatch handoffs
+        self._flow_base = next(_FLOW_NS) << 42
+        self._prefetch_flow = None    # set by run()'s consumer per item
+        # one trace per runner lifetime: every dispatch/retire/
+        # materialize/prefetch span joins it, so a whole training run is
+        # one connected trace even when nothing opened a root span
+        self._trace_ctx = _trace.current() or (_trace.new_trace_id(),
+                                               None)
 
     # -- lifecycle -----------------------------------------------------------
     def __enter__(self):
@@ -226,11 +264,19 @@ class PipelineRunner:
             e = self._window.popleft()
             if not e.fetches:
                 continue  # nothing observable; sync() verifies the carry
+            sp = _trace.begin("pipeline/retire", step_first=e.first,
+                              step_last=e.last,
+                              parent=self._trace_ctx)
+            for i in range(e.first, e.last + 1):
+                sp.flow(self._flow_base + i, "t")
             try:
                 jax.block_until_ready(e.fetches)
             except Exception as exc:
+                sp.attrs["error"] = type(exc).__name__
+                _trace.end(sp)
                 self._record_failure(e.first, e.last, exc)
                 return
+            _trace.end(sp)
 
     def _verify_through(self, index):
         """Materialization boundary: verify (in order) every in-flight
@@ -240,11 +286,19 @@ class PipelineRunner:
             e = self._window.popleft()
             if not e.fetches:
                 continue
+            sp = _trace.begin("pipeline/retire", step_first=e.first,
+                              step_last=e.last, boundary=True,
+                              parent=self._trace_ctx)
+            for i in range(e.first, e.last + 1):
+                sp.flow(self._flow_base + i, "t")
             try:
                 jax.block_until_ready(e.fetches)
             except Exception as exc:
+                sp.attrs["error"] = type(exc).__name__
+                _trace.end(sp)
                 self._record_failure(e.first, e.last, exc)
                 break
+            _trace.end(sp)
         # steps BEFORE the failure still materialize normally; the
         # failure surfaces for any step at-or-after its index
         if self._failure is not None and self._failure[0] <= index:
@@ -260,27 +314,38 @@ class PipelineRunner:
         if self._failure is not None:
             return self._dead_handles(1)[0]
         t0 = time.perf_counter()
-        feed_vals = self._exe._convert_feeds(self._program, feed)
-        entry = self._ensure(feed_vals)
-        scope_vals, prev_slots = self._carry
-        slots = self._slots_in(scope_vals, prev_slots)
-        lr, t = jnp.zeros(()), jnp.zeros((), jnp.int32)
-        if entry.opt is not None:
-            entry.opt._step_count += 1
-            lr = jnp.asarray(entry.opt.get_lr(), jnp.float32)
-            t = jnp.asarray(entry.opt._step_count, jnp.int32)
-        key = _rng.next_key()
-        idx = self._next_index
-        self._next_index += 1
+        sp = _trace.begin("pipeline/dispatch", parent=self._trace_ctx)
+        pf = self._prefetch_flow
+        if pf is not None:        # close the prefetch->dispatch handoff
+            self._prefetch_flow = None
+            sp.flow(pf, "f")
         try:
-            fetches, new_scope, new_slots = entry.jitted(
-                tuple(feed_vals[n] for n in entry.feed_names),
-                scope_vals, slots, lr, t, key)
-        except Exception as exc:
-            self._record_failure(idx, idx, exc)
-            self._host_s += time.perf_counter() - t0
-            return [FetchHandle(None, idx, self)
-                    for _ in entry.fetch_ids]
+            feed_vals = self._exe._convert_feeds(self._program, feed)
+            entry = self._ensure(feed_vals)
+            scope_vals, prev_slots = self._carry
+            slots = self._slots_in(scope_vals, prev_slots)
+            lr, t = jnp.zeros(()), jnp.zeros((), jnp.int32)
+            if entry.opt is not None:
+                entry.opt._step_count += 1
+                lr = jnp.asarray(entry.opt.get_lr(), jnp.float32)
+                t = jnp.asarray(entry.opt._step_count, jnp.int32)
+            key = _rng.next_key()
+            idx = self._next_index
+            self._next_index += 1
+            sp.attrs["step"] = idx
+            sp.flow(self._flow_base + idx, "s")
+            try:
+                fetches, new_scope, new_slots = entry.jitted(
+                    tuple(feed_vals[n] for n in entry.feed_names),
+                    scope_vals, slots, lr, t, key)
+            except Exception as exc:
+                sp.attrs["error"] = type(exc).__name__
+                self._record_failure(idx, idx, exc)
+                self._host_s += time.perf_counter() - t0
+                return [FetchHandle(None, idx, self)
+                        for _ in entry.fetch_ids]
+        finally:
+            _trace.end(sp)
         self._carry = (new_scope, new_slots)
         self._window.append(_Inflight(idx, idx, fetches))
         r0 = time.perf_counter()
@@ -300,35 +365,49 @@ class PipelineRunner:
         if self._failure is not None:
             return self._dead_handles(k)
         t0 = time.perf_counter()
-        feed_vals = self._exe._convert_feeds(self._program, stacked_feed)
-        entry = self._ensure(feed_vals)
-        scope_vals, prev_slots = self._carry
-        slots = self._slots_in(scope_vals, prev_slots)
-        lrs, ts, keys = [], [], []
-        for _ in range(k):  # the exact per-step stream the serial loop
-            if entry.opt is not None:  # would have produced
-                entry.opt._step_count += 1
-                lrs.append(entry.opt.get_lr())
-                ts.append(entry.opt._step_count)
-            else:
-                lrs.append(0.0)
-                ts.append(0)
-            keys.append(_rng.next_key())
-        lrs = jnp.asarray(np.asarray(lrs, np.float32))
-        ts = jnp.asarray(np.asarray(ts, np.int32))
-        keys = jnp.stack(keys)
-        first = self._next_index
-        self._next_index += k
-        last = first + k - 1
+        sp = _trace.begin("pipeline/dispatch_scan", k=k,
+                          parent=self._trace_ctx)
+        pf = self._prefetch_flow
+        if pf is not None:
+            self._prefetch_flow = None
+            sp.flow(pf, "f")
         try:
-            fetches, new_scope, new_slots = entry.scan_jitted()(
-                tuple(feed_vals[n] for n in entry.feed_names),
-                scope_vals, slots, lrs, ts, keys)
-        except Exception as exc:
-            self._record_failure(first, last, exc)
-            self._host_s += time.perf_counter() - t0
-            return [[FetchHandle(None, first + i, self)
-                     for _ in entry.fetch_ids] for i in range(k)]
+            feed_vals = self._exe._convert_feeds(self._program,
+                                                 stacked_feed)
+            entry = self._ensure(feed_vals)
+            scope_vals, prev_slots = self._carry
+            slots = self._slots_in(scope_vals, prev_slots)
+            lrs, ts, keys = [], [], []
+            for _ in range(k):  # the exact per-step stream the serial loop
+                if entry.opt is not None:  # would have produced
+                    entry.opt._step_count += 1
+                    lrs.append(entry.opt.get_lr())
+                    ts.append(entry.opt._step_count)
+                else:
+                    lrs.append(0.0)
+                    ts.append(0)
+                keys.append(_rng.next_key())
+            lrs = jnp.asarray(np.asarray(lrs, np.float32))
+            ts = jnp.asarray(np.asarray(ts, np.int32))
+            keys = jnp.stack(keys)
+            first = self._next_index
+            self._next_index += k
+            last = first + k - 1
+            sp.attrs["step_first"], sp.attrs["step_last"] = first, last
+            for i in range(first, last + 1):
+                sp.flow(self._flow_base + i, "s")
+            try:
+                fetches, new_scope, new_slots = entry.scan_jitted()(
+                    tuple(feed_vals[n] for n in entry.feed_names),
+                    scope_vals, slots, lrs, ts, keys)
+            except Exception as exc:
+                sp.attrs["error"] = type(exc).__name__
+                self._record_failure(first, last, exc)
+                self._host_s += time.perf_counter() - t0
+                return [[FetchHandle(None, first + i, self)
+                         for _ in entry.fetch_ids] for i in range(k)]
+        finally:
+            _trace.end(sp)
         self._carry = (new_scope, new_slots)
         self._window.append(_Inflight(first, last, fetches))
         r0 = time.perf_counter()
@@ -390,35 +469,55 @@ class PipelineRunner:
                     continue
             return False
 
+        # prefetch spans join the caller's trace; each converted item
+        # carries a flow id so the Chrome trace draws the cross-thread
+        # handoff prefetch(s) -> dispatch(f) for every batch
+        parent_ctx = self._trace_ctx
+        flow_seq = itertools.count()
+
+        def _fid():
+            return self._flow_base | (1 << 41) | next(flow_seq)
+
+        def _convert_traced(feed, stacked=False, k=1):
+            fid = _fid()
+            with _trace.span("pipeline/prefetch", stacked=stacked,
+                             k=k) as psp:
+                psp.flow(fid, "s")
+                return convert(feed, stacked), fid
+
+        def _produce():
+            buf, cur_sig = [], None
+            for feed in feeds:
+                if stop.is_set():
+                    return
+                if not scan_k:
+                    if not put(("one",) + _convert_traced(feed)):
+                        return
+                    continue
+                s = sig(feed)
+                if buf and s != cur_sig:  # shape break: no fusion
+                    for f in buf:
+                        if not put(("one",) + _convert_traced(f)):
+                            return
+                    buf = []
+                buf.append(feed)
+                cur_sig = s
+                if len(buf) == scan_k:
+                    stacked = {
+                        n: np.stack([np.asarray(f[n]) for f in buf])
+                        for n in buf[0]}
+                    vals, fid = _convert_traced(stacked, True, scan_k)
+                    if not put(("scan", vals, scan_k, fid)):
+                        return
+                    buf = []
+            for f in buf:  # remainder < K runs unfused
+                if not put(("one",) + _convert_traced(f)):
+                    return
+
         def producer():
             try:
-                buf, cur_sig = [], None
-                for feed in feeds:
-                    if stop.is_set():
-                        return
-                    if not scan_k:
-                        if not put(("one", convert(feed))):
-                            return
-                        continue
-                    s = sig(feed)
-                    if buf and s != cur_sig:  # shape break: no fusion
-                        for f in buf:
-                            if not put(("one", convert(f))):
-                                return
-                        buf = []
-                    buf.append(feed)
-                    cur_sig = s
-                    if len(buf) == scan_k:
-                        stacked = {
-                            n: np.stack([np.asarray(f[n]) for f in buf])
-                            for n in buf[0]}
-                        if not put(("scan", convert(stacked, True),
-                                    scan_k)):
-                            return
-                        buf = []
-                for f in buf:  # remainder < K runs unfused
-                    if not put(("one", convert(f))):
-                        return
+                with _trace.attach(parent_ctx):
+                    _produce()
             except BaseException as e:  # surfaced on the consumer side
                 put(("error", e))
             finally:
@@ -435,8 +534,10 @@ class PipelineRunner:
                 if item[0] == "error":
                     raise item[1]
                 if item[0] == "one":
+                    self._prefetch_flow = item[2]
                     yield self.submit(item[1])
                 else:
+                    self._prefetch_flow = item[3]
                     for handles in self.submit_scan(item[1], item[2]):
                         yield handles
         finally:
@@ -462,17 +563,20 @@ class PipelineRunner:
         from ..core import monitor as _monitor
         if self._entry is None:
             return
-        self._verify_through(self._next_index)
-        new_scope, new_slots = self._carry
-        try:
-            jax.block_until_ready((new_scope, new_slots or {}))
-        except Exception as exc:
-            self._record_failure(
-                self._window[0].first if self._window else
-                max(self._next_index - 1, 0),
-                max(self._next_index - 1, 0), exc)
-            first, last, e = self._failure
-            raise PipelineStepError(first, e, last)
+        with _trace.span("pipeline/sync", parent=self._trace_ctx,
+                         step_first=self._synced_through,
+                         step_last=self._next_index - 1):
+            self._verify_through(self._next_index)
+            new_scope, new_slots = self._carry
+            try:
+                jax.block_until_ready((new_scope, new_slots or {}))
+            except Exception as exc:
+                self._record_failure(
+                    self._window[0].first if self._window else
+                    max(self._next_index - 1, 0),
+                    max(self._next_index - 1, 0), exc)
+                first, last, e = self._failure
+                raise PipelineStepError(first, e, last)
         if _flags.flag("FLAGS_check_nan_inf"):
             # the serial loop swept {fetches, scope} every batch; the
             # pipelined loop sweeps the carry at every sync boundary
@@ -500,6 +604,10 @@ class PipelineRunner:
                     self._host_s * 1000.0 / steps,
                 "executor/inflight_depth": self._depth_peak,
             })
+            # distribution + trajectory, not just the last window's mean
+            _monitor.observe("executor/step_ms", wall_ms / steps)
+            _monitor.observe("executor/host_ms",
+                             self._host_s * 1000.0 / steps)
         self._synced_through = self._next_index
         self._host_s = 0.0
         self._wall_t0 = time.perf_counter()

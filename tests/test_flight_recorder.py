@@ -1,0 +1,268 @@
+"""Flight recorder (core/flight_recorder.py) + obs_report rendering +
+the Chrome-trace acceptance path: a pipelined train_from_dataset run
+exports dispatch/retire/materialize spans linked by flow events across
+threads; a forced PipelineStepError and a PS chaos run each produce a
+dump that tools/obs_report.py renders. See docs/observability.md."""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops, optimizer, static
+from paddle_tpu.core import flight_recorder, trace
+from paddle_tpu.static import PipelineRunner, PipelineStepError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import obs_report  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    flight_recorder._dumped.clear()
+    trace.reset()
+    yield
+    flight_recorder._dumped.clear()
+
+
+@pytest.fixture()
+def dump_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "dumps")
+    monkeypatch.setenv("PADDLE_TPU_DUMP_DIR", d)
+    return d
+
+
+def _dumps(d, reason=None):
+    if not os.path.isdir(d):
+        return []
+    names = sorted(os.listdir(d))
+    if reason is not None:
+        names = [n for n in names if f"_{reason}_" in n]
+    return [os.path.join(d, n) for n in names]
+
+
+def _build(name):
+    paddle.seed(0)
+    prog = static.Program(name)
+    with static.program_guard(prog):
+        x = static.data("x", [-1, 4], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        h = ops.relu(nn.Linear(4, 8)(x))
+        loss = ops.mse_loss(nn.Linear(8, 1)(h), y)
+        optimizer.Adam(learning_rate=0.05).minimize(loss)
+    return prog, loss
+
+
+def _feeds(n, batch=8):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(batch, 4).astype("float32"),
+             "y": rng.rand(batch, 1).astype("float32")}
+            for _ in range(n)]
+
+
+# ------------------------------------------------------------- unit level
+
+def test_dump_noop_without_env(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_DUMP_DIR", raising=False)
+    assert not flight_recorder.enabled()
+    assert flight_recorder.dump("whatever", ValueError("x")) is None
+
+
+def test_dump_schema_and_rate_limit(dump_dir):
+    trace.instant("marker", step=7)
+    from paddle_tpu.core import monitor
+    monitor.stat_add("tm.fr.counter", 3)
+    paths = [flight_recorder.dump("unit", ValueError("boom"),
+                                  extra={"k": 1})
+             for _ in range(flight_recorder.MAX_DUMPS_PER_REASON + 2)]
+    written = [p for p in paths if p]
+    assert len(written) == flight_recorder.MAX_DUMPS_PER_REASON
+    rec = json.load(open(written[0]))
+    assert tuple(rec.keys()) == flight_recorder.SCHEMA_KEYS
+    assert rec["schema"] == flight_recorder.SCHEMA_VERSION
+    assert rec["reason"] == "unit"
+    assert rec["exception"]["type"] == "ValueError"
+    assert rec["extra"] == {"k": 1}
+    assert any(s["name"] == "marker" and s["attrs"].get("step") == 7
+               for s in rec["spans"])
+    assert rec["metrics"]["values"]["tm.fr.counter"] == 3
+    assert "FLAGS_executor_max_inflight" in rec["flags"]
+    monitor.reset(prefix="tm.fr.")
+
+
+def test_suppressed_scope_blocks_reason_on_this_thread(dump_dir):
+    # the Communicator's outer retry layer suppresses premature
+    # "transport death" dumps from inner per-call exhaustion
+    with flight_recorder.suppressed("ps_transport_death"):
+        assert flight_recorder.dump("ps_transport_death") is None
+        assert flight_recorder.dump("other_reason") is not None
+    assert flight_recorder.dump("ps_transport_death") is not None
+
+
+# ------------------------------------------ PipelineStepError -> dump
+
+def test_pipeline_step_error_dumps_and_report_renders(dump_dir):
+    paddle.enable_static()
+    try:
+        prog, loss = _build("fr_chaos")
+        exe = static.Executor()
+        runner = PipelineRunner(exe, prog, fetch_list=[loss],
+                                max_inflight=4)
+        feeds = _feeds(4)
+        runner.submit(feeds[0])
+        entry = runner._entry
+        orig = entry.jitted
+        calls = {"n": 0}
+
+        def bomb(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 2:  # overall step index 2
+                raise RuntimeError("injected chaos")
+            return orig(*a, **k)
+
+        entry.jitted = bomb
+        try:
+            runner.submit(feeds[1])
+            runner.submit(feeds[2])
+            with pytest.raises(PipelineStepError, match="step 2"):
+                runner.sync()
+        finally:
+            entry.jitted = orig
+    finally:
+        paddle.disable_static()
+    dumps = _dumps(dump_dir, "pipeline_step_error")
+    assert dumps, "PipelineStepError did not produce a dump"
+    rec = obs_report.load(dumps[0])
+    assert rec["extra"]["step_index"] == 2
+    text = obs_report.render(rec)
+    assert "== step timeline" in text
+    assert "== ps health" in text
+    assert "== pallas kernels" in text
+    assert "pipeline/dispatch" in text      # host-overhead table rows
+    # the failing run's dispatch spans made it into the timeline
+    assert "injected chaos" in rec["exception"]["message"]
+    # dump -> chrome trace conversion round-trips
+    out = str(os.path.join(dump_dir, "from_dump.json"))
+    obs_report.dump_to_chrome_trace(rec, out)
+    ev = json.load(open(out))["traceEvents"]
+    assert any(e.get("cat") == "flow" for e in ev)
+
+
+# ------------------------------------------------- PS chaos -> dump
+
+def test_ps_transport_death_dumps(dump_dir):
+    from paddle_tpu.distributed.ps import PSClient, PSServer
+    from paddle_tpu.testing import faults
+    srv = PSServer(tables={"emb": {"type": "sparse", "dim": 4,
+                                   "optimizer": "sgd", "lr": 1.0,
+                                   "init": "zeros"}})
+    srv.start()
+    try:
+        client = PSClient([srv.endpoint], timeout=2.0, max_retries=1,
+                          backoff_base=0.01, backoff_max=0.02,
+                          connect_retry_s=5.0)
+        with faults.inject(faults.Fault("client", "send", faults.RESET,
+                                        method="pull_sparse", times=10)):
+            with pytest.raises(ConnectionError):
+                client.pull_sparse("emb", [1, 2])
+        faults.uninstall()
+        client.close()
+    finally:
+        srv.shutdown()
+    dumps = _dumps(dump_dir, "ps_transport_death")
+    assert dumps, "transport death did not produce a dump"
+    rec = obs_report.load(dumps[0])
+    assert rec["extra"]["method"] == "pull_sparse"
+    assert rec["extra"]["attempts"] == 2
+    text = obs_report.render(rec)
+    assert "ps.rpc.retries" in text
+    # the dying call's span is in the dump, error-tagged
+    assert any(s["name"] == "ps.rpc/pull_sparse"
+               and s["attrs"].get("error") for s in rec["spans"])
+
+
+# ------------------------------------------------ fatal-signal hook
+
+@pytest.mark.slow
+def test_signal_dump_in_subprocess(tmp_path):
+    d = str(tmp_path / "sigdumps")
+    code = (
+        "import os, signal, sys\n"
+        "import paddle_tpu\n"           # maybe_install() arms the hook
+        "os.kill(os.getpid(), signal.SIGUSR1)\n"   # on-demand dump
+        "print('alive')\n"              # SIGUSR1 must not kill us
+    )
+    env = dict(os.environ, PADDLE_TPU_DUMP_DIR=d, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr
+    assert "alive" in out.stdout
+    dumps = _dumps(d, "signal_SIGUSR1")
+    assert dumps, f"no signal dump in {d}: {os.listdir(tmp_path)}"
+    rec = json.load(open(dumps[0]))
+    assert rec["reason"] == "signal_SIGUSR1"
+
+
+# ------------------------------- acceptance: chrome trace with flows
+
+def test_pipelined_train_from_dataset_exports_linked_chrome_trace(
+        tmp_path):
+    class _DS:  # minimal train_from_dataset dataset: batches() of feeds
+        def __init__(self, feeds):
+            self._feeds = feeds
+
+        def batches(self):
+            return iter(self._feeds)
+
+    paddle.enable_static()
+    trace.reset()
+    trace.start()
+    try:
+        prog, loss = _build("fr_accept")
+        exe = static.Executor()
+        exe.train_from_dataset(program=prog, dataset=_DS(_feeds(5)),
+                               fetch_list=[loss], print_period=1)
+    finally:
+        spans = trace.stop()
+        paddle.disable_static()
+    dispatch = {s.attrs["step"]: s for s in spans
+                if s.name == "pipeline/dispatch"}
+    assert set(dispatch) == {0, 1, 2, 3, 4}
+    retire_flows = {fid for s in spans if s.name == "pipeline/retire"
+                    for fid, ph in (s.flows or []) if ph == "t"}
+    mat_flows = {fid for s in spans if s.name == "pipeline/materialize"
+                 for fid, ph in (s.flows or []) if ph == "f"}
+    prefetch = [s for s in spans if s.name == "pipeline/prefetch"]
+    assert len(prefetch) == 5
+    for step, d in dispatch.items():
+        step_fid = next(fid for fid, ph in d.flows if ph == "s")
+        # dispatch -> retire -> materialize all linked by one flow id
+        assert step_fid in retire_flows, f"step {step} never retired"
+        assert step_fid in mat_flows, f"step {step} never materialized"
+        # ...and the prefetch handoff terminates on the dispatch span
+        pf_fid = next(fid for fid, ph in d.flows if ph == "f")
+        assert any(pf_fid in [fid for fid, ph in (p.flows or [])
+                              if ph == "s"] for p in prefetch)
+    # the work genuinely crossed threads: prefetch ran off the driver
+    driver_tid = dispatch[0].tid
+    assert any(p.tid != driver_tid for p in prefetch)
+    # every span shares ONE trace id (attach() joined the prefetcher)
+    assert len({s.trace_id for s in [*dispatch.values(), *prefetch]}) == 1
+    # exported chrome trace carries matching s/t/f flow triples
+    path = str(tmp_path / "pipeline_trace.json")
+    trace.export_chrome_trace(path, spans=spans)
+    ev = json.load(open(path))["traceEvents"]
+    flows = [e for e in ev if e.get("cat") == "flow"]
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], set()).add(e["ph"])
+    full_chains = [fid for fid, phases in by_id.items()
+                   if {"s", "t", "f"} <= phases]
+    assert len(full_chains) >= 5  # one complete arrow chain per step
+    tids = {e["tid"] for e in ev if e["ph"] == "X"}
+    assert len(tids) >= 2

@@ -1,0 +1,144 @@
+"""Typed metrics (core/monitor.py): legacy stat_* back-compat, time
+series, histograms, exports, and the atomic prefix reset the bench modes
+depend on. See docs/observability.md."""
+import json
+import threading
+
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401
+from paddle_tpu.core import monitor
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    monitor.reset(prefix="tm.")
+    yield
+    monitor.reset(prefix="tm.")
+
+
+def test_legacy_surface_unchanged():
+    monitor.stat_add("tm.c")
+    monitor.stat_add("tm.c", 2)
+    monitor.stat_set("tm.g", 7.5)
+    monitor.stat_set_many({"tm.a": 1, "tm.b": 2})
+    assert monitor.stat_get("tm.c") == 3
+    assert monitor.stat_get("tm.missing") == 0
+    s = monitor.stats("tm.")
+    assert s["tm.c"] == 3 and s["tm.g"] == 7.5 and s["tm.a"] == 1
+    monitor.reset(name="tm.c")
+    assert monitor.stat_get("tm.c") == 0
+    assert "tm.c" not in monitor.stats("tm.")
+
+
+def test_time_series_bounded_and_ordered():
+    saved = paddle.get_flags(["FLAGS_monitor_series_len"])
+    paddle.set_flags({"FLAGS_monitor_series_len": 5})
+    try:
+        for _ in range(12):
+            monitor.stat_add("tm.ser")
+        ser = monitor.series("tm.ser")
+        assert len(ser) == 5
+        values = [v for _, v in ser]
+        assert values == [8.0, 9.0, 10.0, 11.0, 12.0]  # newest last
+        ts = [t for t, _ in ser]
+        assert ts == sorted(ts)
+    finally:
+        paddle.set_flags(saved)
+
+
+def test_histogram_observe_and_summary():
+    h = monitor.histogram("tm.lat", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    s = monitor.histogram_summary("tm.lat")
+    assert s["count"] == 4
+    assert s["sum"] == pytest.approx(555.5)
+    assert s["min"] == 0.5 and s["max"] == 500.0
+    assert s["buckets"] == [1, 1, 1, 1]  # one per bucket incl. +Inf
+    # histograms surface through the legacy stats() snapshot
+    flat = monitor.stats("tm.lat")
+    assert flat["tm.lat.count"] == 4
+    assert flat["tm.lat.avg"] == pytest.approx(555.5 / 4)
+
+
+def test_typed_handles():
+    c = monitor.counter("tm.h.c")
+    g = monitor.gauge("tm.h.g")
+    c.add()
+    c.add(4)
+    g.set(2.5)
+    assert c.value() == 5 and g.value() == 2.5
+    snap = monitor.snapshot()
+    assert snap["types"]["tm.h.c"] == "counter"
+    assert snap["types"]["tm.h.g"] == "gauge"
+
+
+def test_export_jsonl_and_prometheus(tmp_path):
+    monitor.stat_add("tm.exp.count", 3)
+    monitor.stat_set("tm.exp.gauge", 1.5)
+    monitor.observe("tm.exp.hist", 2.0, buckets=(1.0, 10.0))
+    path = str(tmp_path / "metrics.jsonl")
+    monitor.export_jsonl(path)
+    recs = {r["name"]: r for r in map(json.loads, open(path))}
+    assert recs["tm.exp.count"]["value"] == 3
+    assert recs["tm.exp.count"]["type"] == "counter"
+    assert recs["tm.exp.count"]["series"]  # trajectory rides along
+    assert recs["tm.exp.hist"]["histogram"]["count"] == 1
+    text = monitor.prometheus_text()
+    assert "# TYPE tm_exp_count counter" in text
+    assert "tm_exp_gauge 1.5" in text
+    assert 'tm_exp_hist_bucket{le="10.0"} 1' in text
+    assert 'tm_exp_hist_bucket{le="+Inf"} 1' in text
+    assert "tm_exp_hist_count 1" in text
+
+
+def test_snapshot_consistent_under_lock():
+    monitor.stat_add("tm.snap", 2)
+    snap = monitor.snapshot()
+    assert snap["values"]["tm.snap"] == 2
+    assert snap["series"]["tm.snap"][-1][1] == 2.0
+
+
+def test_prefix_reset_atomic_with_racing_writers():
+    """Regression: reset(prefix=...) must clear value + series +
+    histogram in ONE critical section. A writer may re-create the
+    counter right after, but a snapshot must NEVER show a fresh value
+    carrying a stale (pre-reset) series — which is exactly what a
+    per-structure-lock reset produced mid-bench."""
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        while not stop.is_set():
+            monitor.stat_add("tm.race.c")
+            monitor.observe("tm.race.h", 1.0, buckets=(10.0,))
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            monitor.reset(prefix="tm.race.")
+            snap = monitor.snapshot()
+            val = snap["values"].get("tm.race.c")
+            ser = snap.get("series", {}).get("tm.race.c", [])
+            if val is not None and ser:
+                # counter restarted at 1,2,3,... after the reset; its
+                # newest series sample IS the current value, and no
+                # sample can exceed it (a stale pre-reset series would)
+                if ser[-1][1] != val or max(v for _, v in ser) > val:
+                    errors.append((val, ser[-3:]))
+            hist = snap["histograms"].get("tm.race.h")
+            hser = snap.get("series", {}).get("tm.race.h", [])
+            if hist is not None and len(hser) > hist["count"]:
+                errors.append(("hist", hist["count"], len(hser)))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, f"non-atomic prefix reset observed: {errors[:3]}"
+    monitor.reset(prefix="tm.race.")
+    assert monitor.stats("tm.race.") == {}
+    assert monitor.series("tm.race.c") == []
+    assert monitor.histogram_summary("tm.race.h") is None

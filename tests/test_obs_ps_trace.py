@@ -1,0 +1,125 @@
+"""Trace-context propagation through the PS transport under chaos
+(testing/faults.py): retries and server-side replays must reuse the
+originating trace id, and spans must survive a mid-call reconnect. The
+servers run in-process, so client- AND server-side spans land in one
+trace ring and the correlation is directly assertable."""
+import numpy as np
+import pytest
+
+from paddle_tpu.core import trace
+from paddle_tpu.distributed.ps import PSClient, PSServer
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.chaos
+
+DIM = 4
+FAST = dict(timeout=5.0, max_retries=3, backoff_base=0.01,
+            backoff_max=0.05, connect_retry_s=5.0)
+
+
+@pytest.fixture()
+def server():
+    srv = PSServer(tables={"emb": {"type": "sparse", "dim": DIM,
+                                   "optimizer": "sgd", "lr": 1.0,
+                                   "init": "zeros"}})
+    srv.start()
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.reset()
+    yield
+    faults.uninstall()
+    trace.reset()
+
+
+def _spans(name):
+    return [s for s in trace.recent() if s.name == name]
+
+
+def test_server_span_parents_to_client_call(server):
+    client = PSClient([server.endpoint], **FAST)
+    client.pull_sparse("emb", [1, 2, 3])
+    client.close()
+    csp = _spans("ps.rpc/pull_sparse")[-1]
+    ssp = _spans("ps.server/pull_sparse")[-1]
+    # cross-"process" correlation: same trace id, parented to the call
+    assert ssp.trace_id == csp.trace_id
+    assert ssp.parent_id == csp.span_id
+    assert ssp.attrs["outcome"] == "apply"
+    assert csp.attrs["attempts"] == 1
+    assert ssp.tid != csp.tid  # handler ran on the server's conn thread
+
+
+def test_replayed_mutation_reuses_originating_trace_id(server):
+    client = PSClient([server.endpoint], **FAST)
+    grads = np.ones((2, DIM), np.float32)
+    # drop exactly the first push reply: the request WAS applied, the
+    # retry must hit the replay cache — both server spans one trace
+    with faults.inject(faults.Fault("server", "reply", faults.DROP,
+                                    method="push_sparse_grad")) as inj:
+        client.push_sparse_grad("emb", [1, 2], grads)
+    assert inj.fired(faults.DROP) == 1
+    client.close()
+    csp = _spans("ps.rpc/push_sparse_grad")[-1]
+    server_spans = [s for s in _spans("ps.server/push_sparse_grad")
+                    if s.trace_id == csp.trace_id]
+    outcomes = [s.attrs["outcome"] for s in server_spans]
+    assert outcomes == ["apply", "replay"], outcomes
+    # the retry carried the SAME frame bytes: both server spans parent
+    # to the one client span of the one logical call
+    assert {s.parent_id for s in server_spans} == {csp.span_id}
+    assert csp.attrs["attempts"] == 2
+    assert csp.attrs["mutating"] is True
+    # exactly-once still holds under the shared trace context
+    assert client_applied(server) == 1
+
+
+def client_applied(server):
+    c = PSClient([server.endpoint], **FAST)
+    try:
+        return c.table_applied("emb")
+    finally:
+        c.close()
+
+
+def test_span_survives_mid_call_reconnect(server):
+    client = PSClient([server.endpoint], **FAST)
+    # two resets at the send boundary force teardown + re-dial (and a
+    # re-auth handshake path) INSIDE one logical call
+    with faults.inject(faults.Fault("client", "send", faults.RESET,
+                                    method="pull_sparse", times=2)) as inj:
+        rows = client.pull_sparse("emb", [5, 6])
+    assert rows.shape == (2, DIM)
+    assert inj.fired(faults.RESET) == 2
+    client.close()
+    csp = _spans("ps.rpc/pull_sparse")[-1]
+    assert csp.attrs["attempts"] == 3      # one span across all attempts
+    assert csp.t1 is not None
+    ssp = [s for s in _spans("ps.server/pull_sparse")
+           if s.trace_id == csp.trace_id]
+    # the attempt that finally landed still correlates to the call
+    assert ssp and ssp[-1].parent_id == csp.span_id
+
+
+def test_chaos_run_keeps_traces_connected(server):
+    """Seeded chaos: every server-side span observed during the storm
+    belongs to SOME client call span's trace (no orphan traces), and
+    mutations stay exactly-once."""
+    client = PSClient([server.endpoint], **FAST)
+    grads = np.ones((3, DIM), np.float32)
+    with faults.inject(seed=11, p={faults.RESET: 0.1, faults.DROP: 0.1}):
+        for i in range(20):
+            client.push_sparse_grad("emb", [i, i + 1, i + 2], grads)
+    client.close()
+    client_traces = {s.trace_id
+                     for s in _spans("ps.rpc/push_sparse_grad")}
+    server_spans = _spans("ps.server/push_sparse_grad")
+    assert len(client_traces) == 20
+    assert len(server_spans) >= 20
+    orphans = [s for s in server_spans
+               if s.trace_id not in client_traces]
+    assert not orphans, f"server spans outside any call trace: {orphans}"
+    assert client_applied(server) == 20

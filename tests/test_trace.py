@@ -1,0 +1,169 @@
+"""Span tracer (core/trace.py): ids/parenting, cross-thread attach,
+always-on ring, capture buffer, Chrome export with flow events, and the
+profiler.RecordEvent absorption. See docs/observability.md."""
+import json
+import threading
+
+import pytest
+
+import paddle_tpu as paddle  # noqa: F401 — flags registered
+from paddle_tpu.core import trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    trace.reset()
+    yield
+    if trace.enabled():
+        trace.stop()
+    trace.reset()
+
+
+def test_span_nesting_and_ids():
+    with trace.span("outer", kind="test") as outer:
+        assert trace.current() == (outer.trace_id, outer.span_id)
+        with trace.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            assert inner.trace_id == outer.trace_id
+        with trace.span("inner2") as inner2:
+            assert inner2.parent_id == outer.span_id
+    assert trace.current() is None
+    assert outer.t1 is not None and outer.t1 >= outer.t0
+    assert outer.attrs["kind"] == "test"
+    # separate roots get separate traces
+    with trace.span("other") as other:
+        assert other.trace_id != outer.trace_id
+        assert other.parent_id is None
+
+
+def test_span_exception_records_error_and_reraises():
+    with pytest.raises(ValueError):
+        with trace.span("boom") as sp:
+            raise ValueError("x")
+    assert sp.attrs["error"] == "ValueError"
+    assert sp.t1 is not None  # finished despite the exception
+
+
+def test_ring_is_bounded_and_always_on():
+    trace.set_ring_size(8)
+    try:
+        assert not trace.enabled()  # ring records even without capture
+        for i in range(20):
+            trace.instant(f"e{i}")
+        recent = trace.recent()
+        assert len(recent) == 8
+        assert recent[-1].name == "e19"  # newest last
+        assert trace.recent(3)[0].name == "e17"
+    finally:
+        trace.set_ring_size(4096)
+
+
+def test_capture_buffer_only_between_start_stop():
+    trace.instant("before")
+    trace.start()
+    trace.instant("during")
+    spans = trace.stop()
+    trace.instant("after")
+    assert [s.name for s in spans] == ["during"]
+    assert {s.name for s in trace.recent()} >= {"before", "during",
+                                                "after"}
+
+
+def test_attach_joins_worker_thread_to_trace():
+    out = {}
+    with trace.span("driver") as sp:
+        ctx = trace.current()
+
+        def worker():
+            with trace.attach(ctx):
+                with trace.span("work") as w:
+                    out["w"] = w
+            out["after"] = trace.current()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert out["w"].trace_id == sp.trace_id
+    assert out["w"].parent_id == sp.span_id
+    assert out["after"] is None          # attach scope fully popped
+    assert out["w"].tid != sp.tid        # genuinely another thread
+
+
+def test_remote_parent_tuple_propagates_trace_id():
+    # the PS server resolves the client-shipped (trace_id, span_id)
+    with trace.span("handler", parent=("cafe-1", "cafe-2")) as sp:
+        assert sp.trace_id == "cafe-1"
+        assert sp.parent_id == "cafe-2"
+
+
+def test_chrome_export_slices_flows_and_thread_names(tmp_path):
+    trace.start()
+    with trace.span("dispatch", step=0) as d:
+        d.flow(41, "s")
+    with trace.span("retire") as r:
+        r.flow(41, "t")
+    with trace.span("materialize") as m:
+        m.flow(41, "f")
+    trace.stop()
+    path = str(tmp_path / "trace.json")
+    trace.export_chrome_trace(path, spans=[d, r, m])
+    data = json.load(open(path))
+    ev = data["traceEvents"]
+    slices = [e for e in ev if e["ph"] == "X"]
+    flows = [e for e in ev if e.get("cat") == "flow"]
+    metas = [e for e in ev if e["ph"] == "M"]
+    assert {e["name"] for e in slices} == {"dispatch", "retire",
+                                           "materialize"}
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    assert all(e["id"] == 41 for e in flows)
+    assert flows[-1]["bp"] == "e"
+    assert metas and metas[0]["args"]["name"]
+    # slice args carry span identity + attrs
+    disp = next(e for e in slices if e["name"] == "dispatch")
+    assert disp["args"]["step"] == 0
+    assert disp["args"]["trace_id"] == d.trace_id
+    # flow ts binds inside its slice
+    assert disp["ts"] <= flows[0]["ts"] <= disp["ts"] + disp["dur"]
+
+
+def test_record_event_missed_end_cannot_corrupt_parentage():
+    """Legacy begin()/end() callers (tape.py per-op annotations) skip
+    end() when the op raises; the RecordEvent span is detached, so the
+    leak costs one sample — NOT a dead ancestor for every later span."""
+    from paddle_tpu import profiler as prof
+    prof.start_profiler()
+    try:
+        prof.RecordEvent("op/leaky").begin()   # end() never called
+        assert trace.current() is None          # ambient stack untouched
+        with trace.span("after") as sp:
+            assert sp.parent_id is None         # fresh root, not 'leaky'
+    finally:
+        prof.stop_profiler()
+    prof.reset_profiler()
+
+
+def test_record_event_absorbed_into_tracer():
+    from paddle_tpu import profiler as prof
+    prof.reset_profiler()
+    ring_before = len(trace.recent())
+    rec = prof.RecordEvent("cheap")
+    rec.begin()
+    rec.end()
+    # disabled profiler: RecordEvent stays a no-op (hot per-op sites)
+    assert len(trace.recent()) == ring_before
+    assert prof.events() == []
+    prof.start_profiler()
+    try:
+        with trace.span("outer") as outer:
+            with prof.RecordEvent("annotated"):
+                pass
+        names = [e[0] for e in prof.events()]
+        # RecordEvent became a span nested under the ambient one...
+        sp = next(s for s in trace.recent() if s.name == "annotated")
+        assert sp.parent_id == outer.span_id
+        # ...and first-class trace spans reach the profiler table too
+        assert "annotated" in names and "outer" in names
+        assert "annotated" in prof.summary()
+    finally:
+        prof.stop_profiler()
+    prof.reset_profiler()

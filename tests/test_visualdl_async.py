@@ -1,0 +1,104 @@
+"""VisualDL under the async fit loop: the default sample_freq drains at
+the log_freq window boundary — where fit() has ALREADY materialized the
+window — so streaming per-batch losses costs ZERO extra device syncs;
+sample_freq=1 restores (and demonstrates) the per-batch-sync behavior.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import Model, nn, optimizer
+from paddle_tpu.hapi import model as model_mod
+from paddle_tpu.hapi.callbacks import VisualDL
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.utils.log_writer import read_scalars
+
+N_BATCHES = 20
+LOG_FREQ = 10
+
+
+def _fit_with_spy(monkeypatch, tmp_path, sample_freq, epochs=1,
+                  n_batches=N_BATCHES):
+    """Run a small async fit with VisualDL attached; count 'forced'
+    loss reads — value() calls that hit a not-yet-drained window entry
+    (each one is an extra device sync the pipeline paid for)."""
+    forced = []
+    orig_value = model_mod._LazyLoss.value
+
+    def spy(self):
+        if self._val is None:
+            forced.append(self.step)
+        return orig_value(self)
+
+    monkeypatch.setattr(model_mod._LazyLoss, "value", spy)
+    paddle.seed(7)
+    x = np.random.RandomState(0).rand(n_batches * 2, 4).astype("float32")
+    y = (x.sum(axis=1, keepdims=True)).astype("float32")
+    ds = TensorDataset([x, y])
+    model = Model(nn.Linear(4, 1))
+    model.prepare(optimizer=optimizer.SGD(
+        learning_rate=0.01, parameters=model.parameters()),
+        loss=nn.MSELoss())
+    logdir = str(tmp_path / f"vdl_{sample_freq}_{epochs}")
+    cb = VisualDL(logdir, sample_freq=sample_freq)
+    model.fit(ds, batch_size=2, epochs=epochs, verbose=0,
+              log_freq=LOG_FREQ, callbacks=[cb], shuffle=False)
+    recs = read_scalars(logdir, tag="train/loss")
+    return forced, recs
+
+
+def test_default_sample_freq_adds_no_syncs(monkeypatch, tmp_path):
+    # sanity: the async loop is actually on
+    assert paddle.get_flags(["FLAGS_executor_max_inflight"])[
+        "FLAGS_executor_max_inflight"] > 0
+    forced, recs = _fit_with_spy(monkeypatch, tmp_path,
+                                 sample_freq=LOG_FREQ)
+    # window-boundary drain: every loss VisualDL read was already
+    # materialized by fit's own log_freq drain — zero extra syncs
+    assert forced == [], f"VisualDL forced early syncs at {forced}"
+    # ...and per-batch records are all there, exact, in order
+    assert [r["step"] for r in recs] == list(range(1, N_BATCHES + 1))
+    assert all(np.isfinite(r["value"]) for r in recs)
+
+
+def test_multi_epoch_odd_length_stays_aligned(monkeypatch, tmp_path):
+    """Regression: the flush cadence keys on fit's PER-EPOCH step, not a
+    global counter — with 15 batches/epoch (not a multiple of 10) the
+    second epoch's flushes must still land on drained boundaries."""
+    forced, recs = _fit_with_spy(monkeypatch, tmp_path,
+                                 sample_freq=LOG_FREQ, epochs=2,
+                                 n_batches=15)
+    assert forced == [], f"epoch-2 flush forced early syncs at {forced}"
+    assert len(recs) == 30  # every batch of both epochs recorded
+
+
+def test_sample_freq_1_forces_per_batch_syncs(monkeypatch, tmp_path):
+    forced, recs = _fit_with_spy(monkeypatch, tmp_path, sample_freq=1)
+    # the old write-every-batch behavior: most batches force a drain
+    # of their own not-yet-retired step (the window keeps 2 in flight)
+    assert len(forced) > N_BATCHES // 2, forced
+    assert [r["step"] for r in recs] == list(range(1, N_BATCHES + 1))
+
+
+def test_values_identical_across_sample_freqs(monkeypatch, tmp_path):
+    _, eager = _fit_with_spy(monkeypatch, tmp_path, sample_freq=1)
+    _, lazy = _fit_with_spy(monkeypatch, tmp_path,
+                            sample_freq=LOG_FREQ)
+    # buffering only defers the WRITE; the recorded losses are the
+    # exact per-step values either way
+    np.testing.assert_allclose([r["value"] for r in eager],
+                               [r["value"] for r in lazy], rtol=0, atol=0)
+
+
+def test_sync_loop_unaffected(monkeypatch, tmp_path):
+    # inflight=0 restores the fully synchronous loop: losses are plain
+    # floats and VisualDL still records every batch
+    saved = paddle.get_flags(["FLAGS_executor_max_inflight"])
+    paddle.set_flags({"FLAGS_executor_max_inflight": 0})
+    try:
+        forced, recs = _fit_with_spy(monkeypatch, tmp_path,
+                                     sample_freq=LOG_FREQ)
+    finally:
+        paddle.set_flags(saved)
+    assert forced == []
+    assert len(recs) == N_BATCHES

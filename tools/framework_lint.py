@@ -359,7 +359,8 @@ def check_concretization(ops_dir=OPS_DIR):
 # Cross-check registry: domain lints that ride along with the framework
 # gate. Each module lives in tools/, exposes `self_check()` returning a
 # list of violation strings, and `main(argv)` for standalone use.
-TOOL_CROSS_CHECKS = ["spmd_lint", "hlo_evidence", "pipeline_lint"]
+TOOL_CROSS_CHECKS = ["spmd_lint", "hlo_evidence", "pipeline_lint",
+                     "obs_report"]
 
 
 def check_registered_tools():

@@ -1,0 +1,293 @@
+"""Observability report: render a flight-recorder dump (or a live run).
+
+Turns the always-on telemetry (core/trace.py span ring + core/monitor
+typed metrics) into the four answers an operator actually asks after a
+failed or slow run:
+
+  1. per-step TIMELINE — dispatch / retire / materialize spans of the
+     async pipeline, with durations and the thread that ran each;
+  2. HOST-OVERHEAD breakdown — aggregate span table (the profiler
+     summary, but from the flight recorder, so it works post-mortem);
+  3. PS HEALTH — retries / reconnects / deadline-exceeded / replays /
+     bad frames, plus RPC latency histogram when present;
+  4. PALLAS fallback rates — per-kernel hit / fallback / gate-reject
+     with reasons.
+
+Usage:
+  python tools/obs_report.py DUMP.json          # render a dump
+  python tools/obs_report.py --live             # snapshot this process
+  python tools/obs_report.py DUMP.json --trace out.json
+                                # also convert the dump's spans to a
+                                # Chrome trace (chrome://tracing)
+
+`self_check()` is registered in tools/framework_lint.py TOOL_CROSS_CHECKS
+so tier-1 pins the three encodings of the observability config against
+each other: the flight-recorder dump schema this renderer expects, the
+core flag defaults (ring/series sizes), and bench.py's per-mode metrics
+snapshot emission.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import defaultdict
+
+TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS_DIR)
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# canonical observability config: the flag DEFAULTS (core/flags.py) must
+# match, and the dump schema version must match the recorder's
+OBS_CFG = {"ring": 4096, "series": 256, "schema": 1}
+
+# dump keys this renderer reads; self_check pins them against
+# flight_recorder.SCHEMA_KEYS so the two cannot drift
+EXPECTED_KEYS = ("schema", "reason", "time", "pid", "argv", "exception",
+                 "spans", "metrics", "flags", "env", "extra")
+
+_STEP_SPANS = ("pipeline/dispatch", "pipeline/dispatch_scan",
+               "pipeline/retire", "pipeline/materialize")
+
+
+def load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def live_record() -> dict:
+    """A dump-shaped record of the CURRENT process (no file involved)."""
+    from paddle_tpu.core import flight_recorder
+    return flight_recorder.record("live")
+
+
+# -- sections ----------------------------------------------------------------
+
+def _fmt_table(headers, rows):
+    if not rows:
+        return "  (none)"
+    widths = [max(len(str(h)), *(len(str(r[i])) for r in rows))
+              for i, h in enumerate(headers)]
+    out = ["  " + "  ".join(f"{h:<{w}}" for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  " + "  ".join(f"{str(c):<{w}}"
+                                    for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def _steps_of(span):
+    a = span.get("attrs", {})
+    if "step" in a:
+        return [a["step"]]
+    if "step_first" in a:
+        return list(range(int(a["step_first"]), int(a["step_last"]) + 1))
+    return []
+
+
+def step_timeline(spans) -> str:
+    """Rows: step -> when each pipeline phase touched it, on which
+    thread, how long."""
+    per_step = defaultdict(dict)
+    threads = defaultdict(set)
+    for sp in spans:
+        name = sp.get("name")
+        if name not in _STEP_SPANS:
+            continue
+        phase = {"pipeline/dispatch": "dispatch",
+                 "pipeline/dispatch_scan": "dispatch",
+                 "pipeline/retire": "retire",
+                 "pipeline/materialize": "materialize"}[name]
+        for step in _steps_of(sp):
+            cur = per_step[step].get(phase)
+            if cur is None or sp["ts_us"] < cur["ts_us"]:
+                per_step[step][phase] = sp
+            threads[step].add(sp.get("thread"))
+    rows = []
+    for step in sorted(per_step):
+        phases = per_step[step]
+        row = [step]
+        for ph in ("dispatch", "retire", "materialize"):
+            sp = phases.get(ph)
+            row.append("-" if sp is None
+                       else f"{sp['ts_us'] / 1e3:.2f}+"
+                            f"{sp['dur_us'] / 1e3:.2f}ms")
+        err = next((p["attrs"]["error"] for p in phases.values()
+                    if p.get("attrs", {}).get("error")), "")
+        row.append(err)
+        row.append(len([t for t in threads[step] if t]))
+        rows.append(row)
+    return _fmt_table(
+        ["step", "dispatch", "retire", "materialize", "error", "threads"],
+        rows)
+
+
+def host_breakdown(spans) -> str:
+    agg = defaultdict(lambda: [0, 0.0, 0.0])  # calls, total_ms, max_ms
+    for sp in spans:
+        ms = sp.get("dur_us", 0) / 1e3
+        a = agg[sp.get("name", "?")]
+        a[0] += 1
+        a[1] += ms
+        a[2] = max(a[2], ms)
+    rows = [[name, n, f"{tot:.3f}", f"{tot / n:.3f}", f"{mx:.3f}"]
+            for name, (n, tot, mx) in
+            sorted(agg.items(), key=lambda kv: -kv[1][1])]
+    return _fmt_table(["span", "calls", "total_ms", "avg_ms", "max_ms"],
+                      rows)
+
+
+def ps_health(metrics) -> str:
+    values = metrics.get("values", {})
+    rows = [[k, v] for k, v in sorted(values.items())
+            if k.startswith(("ps.rpc.", "ps.communicator."))]
+    out = [_fmt_table(["counter", "value"], rows)]
+    lat = metrics.get("histograms", {}).get("ps.rpc/latency_ms")
+    if lat:
+        out.append(f"  rpc latency: n={lat['count']} "
+                   f"avg={lat['avg']:.3f}ms min={lat['min']:.3f}ms "
+                   f"max={lat['max']:.3f}ms")
+    return "\n".join(out)
+
+
+def pallas_rates(metrics) -> str:
+    """Per-kernel engagement: pallas.hit.K / pallas.fallback.K.reason /
+    pallas.gate_reject.K.reason -> hit/fallback/reject counts + rate."""
+    per = defaultdict(lambda: {"hit": 0.0, "fallback": 0.0,
+                               "gate_reject": 0.0, "reasons": []})
+    for name, v in metrics.get("values", {}).items():
+        if not name.startswith("pallas."):
+            continue
+        parts = name.split(".")
+        kind = parts[1]
+        if kind == "hit" and len(parts) >= 3:
+            per[parts[2]]["hit"] += v
+        elif kind in ("fallback", "gate_reject") and len(parts) >= 4:
+            per[parts[2]][kind] += v
+            per[parts[2]]["reasons"].append(
+                f"{kind}:{'.'.join(parts[3:])}={int(v)}")
+    rows = []
+    for k in sorted(per):
+        d = per[k]
+        total = d["hit"] + d["fallback"]
+        rate = (d["fallback"] / total) if total else 0.0
+        rows.append([k, int(d["hit"]), int(d["fallback"]),
+                     int(d["gate_reject"]), f"{rate:.1%}",
+                     " ".join(d["reasons"])])
+    return _fmt_table(
+        ["kernel", "hits", "fallbacks", "gate_rejects", "fallback_rate",
+         "detail"], rows)
+
+
+def render(dump: dict) -> str:
+    out = []
+    exc = dump.get("exception")
+    out.append("== flight-recorder dump "
+               f"(schema {dump.get('schema')}) ==")
+    out.append(f"  reason: {dump.get('reason')}  pid: {dump.get('pid')}")
+    if exc:
+        out.append(f"  exception: {exc.get('type')}: {exc.get('message')}")
+    extra = dump.get("extra") or {}
+    if extra:
+        out.append(f"  extra: {json.dumps(extra, default=str)}")
+    spans = dump.get("spans", [])
+    metrics = dump.get("metrics", {})
+    out.append(f"\n== step timeline ({len(spans)} spans recorded) ==")
+    out.append(step_timeline(spans))
+    out.append("\n== host overhead ==")
+    out.append(host_breakdown(spans))
+    out.append("\n== ps health ==")
+    out.append(ps_health(metrics))
+    out.append("\n== pallas kernels ==")
+    out.append(pallas_rates(metrics))
+    return "\n".join(out)
+
+
+def dump_to_chrome_trace(dump: dict, path: str):
+    """Convert a dump's serialized spans into a Chrome trace file, via
+    the one encoder in core/trace.py (span_dict records are accepted
+    directly, so the slice/flow/instant/thread-name treatment cannot
+    drift from live exports)."""
+    from paddle_tpu.core import trace as _trace
+    events = _trace.to_chrome_events(dump.get("spans", []),
+                                     pid=dump.get("pid", 0))
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path
+
+
+# -- framework_lint cross-check ---------------------------------------------
+
+def self_check():
+    problems = []
+    try:
+        from paddle_tpu.core import flight_recorder, monitor
+        from paddle_tpu.core import flags as _flags
+    except Exception as e:
+        return [f"obs_report: paddle_tpu import failed: {e!r}"]
+    # dump schema <-> renderer expectations
+    if tuple(flight_recorder.SCHEMA_KEYS) != EXPECTED_KEYS:
+        problems.append(
+            "obs_report: flight_recorder.SCHEMA_KEYS "
+            f"{flight_recorder.SCHEMA_KEYS} != renderer EXPECTED_KEYS "
+            f"{EXPECTED_KEYS} — update both together")
+    if flight_recorder.SCHEMA_VERSION != OBS_CFG["schema"]:
+        problems.append(
+            f"obs_report: dump schema v{flight_recorder.SCHEMA_VERSION} "
+            f"!= renderer v{OBS_CFG['schema']}")
+    # flag DECLARED defaults (not live values — a test may have set them)
+    defs = _flags._DEFS
+    for name, want in (("FLAGS_trace_ring_size", OBS_CFG["ring"]),
+                       ("FLAGS_monitor_series_len", OBS_CFG["series"])):
+        if name not in defs:
+            problems.append(f"obs_report: flag {name} is gone but the "
+                            "tracer/monitor depend on it")
+        elif int(defs[name][1]) != want:
+            problems.append(
+                f"obs_report: flag {name} default {defs[name][1]} != "
+                f"OBS_CFG {want} — update the canonical config")
+    # monitor export surface the dump format relies on
+    for fn in ("snapshot", "export_jsonl", "prometheus_text", "observe"):
+        if not callable(getattr(monitor, fn, None)):
+            problems.append(f"obs_report: core.monitor.{fn}() is gone "
+                            "but the dump/report format depends on it")
+    # bench must snapshot the counters per mode (BENCH_*.json carries
+    # them); pin the emission the same way pipeline_lint pins env vars
+    with open(os.path.join(REPO, "bench.py")) as f:
+        src = f.read()
+    if "metrics_snapshot" not in src or "monitor.snapshot" not in src:
+        problems.append(
+            "obs_report: bench.py no longer emits the per-mode "
+            "metrics_snapshot line (monitor.snapshot) — BENCH_*.json "
+            "would lose the counters")
+    return problems
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--self-check" in argv:
+        problems = self_check()
+        for p in problems:
+            print(p)
+        return 1 if problems else 0
+    trace_out = None
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        trace_out = argv[i + 1]
+        del argv[i:i + 2]
+    if "--live" in argv:
+        dump = live_record()
+    elif argv:
+        dump = load(argv[0])
+    else:
+        print(__doc__)
+        return 2
+    print(render(dump))
+    if trace_out:
+        dump_to_chrome_trace(dump, trace_out)
+        print(f"\nchrome trace written to {trace_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
