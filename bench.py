@@ -634,9 +634,12 @@ def bench_pipeline():
 
 
 def _probe_backend(timeout_s):
-    """Fail fast when the TPU tunnel is wedged (init can hang forever on a
-    stale pool lease): probe jax.devices() in a thread; on timeout, emit a
-    diagnostic JSON line and exit nonzero instead of hanging the driver."""
+    """Detect a wedged TPU tunnel (init can hang forever on a stale pool
+    lease): probe jax.devices() in a thread. Returns True when the
+    backend is up; False on timeout — the caller DEGRADES to the
+    tunnel-independent evidence bench instead of emitting bench_error
+    (BENCH_r02–r05 were all errors; ROADMAP names the degrade path as
+    the perf-gate prerequisite)."""
     import threading
     done = {}
 
@@ -648,13 +651,70 @@ def _probe_backend(timeout_s):
     t.start()
     t.join(timeout_s)
     if "devices" not in done:
-        print(json.dumps({
-            "metric": "bench_error", "value": 0, "unit": "none",
-            "vs_baseline": 0,
-            "error": f"jax backend init did not complete in {timeout_s}s "
-                     "(TPU tunnel unreachable)"}), flush=True)
-        os._exit(3)
+        print(f"# jax backend init did not complete in {timeout_s}s "
+              "(TPU tunnel unreachable); degrading to the "
+              "tools/hlo_evidence.py cost-analysis bench",
+              file=sys.stderr, flush=True)
+        return False
     print(f"# devices: {done['devices']}", file=sys.stderr, flush=True)
+    return True
+
+
+def _degraded_evidence_bench():
+    """Tunnel-down bench: AOT-lower the bench graphs for a TPU target on
+    the CPU host (tools/hlo_evidence.py), report XLA cost-analysis
+    FLOPs/bytes per step vs the committed HLO_EVIDENCE.json baseline as
+    REAL bench records, then run the CPU-valid pipeline mode. Runs in
+    this process — main() re-execs us in a clean JAX_PLATFORMS=cpu child
+    because the parent's jax may be wedged mid-init on the tunnel."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import tempfile
+
+    import hlo_evidence
+
+    baseline = {}
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "HLO_EVIDENCE.json")
+    try:
+        with open(base_path) as f:
+            baseline = json.load(f).get("graphs", {})
+    except (OSError, ValueError):
+        pass
+    tiny = os.environ.get("BENCH_EVIDENCE_TINY", "") not in ("", "0")
+    out = os.environ.get(
+        "BENCH_EVIDENCE_OUT",
+        os.path.join(tempfile.gettempdir(), "bench_hlo_evidence.json"))
+    report = hlo_evidence.run(out, tiny=tiny)
+    ok = all(a["ok"] for a in report.get("assertions", []))
+    for name, g in report.get("graphs", {}).items():
+        cost = g.get("cost_analysis") or {}
+        flops = cost.get("flops")
+        base_flops = None
+        if not tiny:
+            base_flops = (baseline.get(name, {}).get("cost_analysis")
+                          or {}).get("flops")
+        # vs_baseline > 1 would mean the graph got CHEAPER than the
+        # committed baseline; < 1 flags a FLOPs regression per step
+        vs = round(base_flops / flops, 4) if base_flops and flops else 1.0
+        print(json.dumps({
+            "metric": f"{name}_hlo_cost",
+            "value": flops if flops is not None else 0,
+            "unit": "flops/step",
+            "vs_baseline": vs,
+            "bytes_accessed": cost.get("bytes accessed"),
+            "custom_calls": g.get("custom_calls"),
+            "kernel_assertions_ok": ok,
+            "degraded": "tpu_tunnel_unreachable",
+        }), flush=True)
+    # host-overhead pipeline mode measures real, CPU-valid throughput
+    try:
+        bench_pipeline()
+        _emit_metrics_snapshot("pipeline")
+    except Exception as e:
+        print(f"# pipeline bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+    return 0 if report.get("graphs") else 3
 
 
 def _emit_metrics_snapshot(mode):
@@ -676,7 +736,18 @@ def _emit_metrics_snapshot(mode):
 
 
 def main():
-    _probe_backend(float(os.environ.get("BENCH_INIT_TIMEOUT", 600)))
+    if os.environ.get("BENCH_DEGRADED_CHILD"):
+        sys.exit(_degraded_evidence_bench())
+    if not _probe_backend(float(os.environ.get("BENCH_INIT_TIMEOUT", 600))):
+        # the parent's jax may be wedged mid-init holding import locks —
+        # run the evidence bench in a clean CPU child and mirror its
+        # stdout (the driver sees real records either way)
+        import subprocess
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "PALLAS_AXON_POOL_IPS": "", "BENCH_DEGRADED_CHILD": "1"}
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env)
+        os._exit(r.returncode)
     mode = os.environ.get("BENCH_MODE", "all")
     if mode in ("bert", "all"):
         bench_bert()          # flagship: FIRST stdout line

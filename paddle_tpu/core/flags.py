@@ -205,3 +205,38 @@ define_flag("PADDLE_PS_SEND_RETRIES", 2,
             "extra Communicator send-thread attempts (with backoff) on "
             "top of the per-call transport retries before the thread "
             "declares itself dead")
+
+# --- PS replicated storage tier (distributed/ps/{shard_map,replica}.py) --
+define_flag("PADDLE_PS_REPLICA_BACKUPS", 0,
+            "backups per shard when the fleet wiring builds the initial "
+            "shard map (0 = replication off: the default map reproduces "
+            "the legacy id%n_servers placement exactly). With k>0 every "
+            "mutation is applied on the primary, forwarded to its "
+            "backups under the SAME replay id, and acked only once "
+            "durable on the write quorum")
+define_flag("PADDLE_PS_REPLICA_QUORUM", 0,
+            "replicas (primary included) that must ack a write before "
+            "the client is acked; 0 = every LIVE replica (unreachable "
+            "backups are evicted from the map rather than wedging "
+            "writes)")
+define_flag("PADDLE_PS_REPLICA_DELTA_LOG", 512,
+            "per-table entries in the replay-keyed mutation log primaries "
+            "keep for rejoin catch-up: a restarted server loads the "
+            "snapshot, then replays the log suffix past its cursor; a "
+            "cursor that fell off the bounded log restarts the fetch")
+define_flag("PADDLE_PS_HEARTBEAT_S", 0.5,
+            "replica heartbeat interval in seconds: every server beats "
+            "replica_beat into its peers; beat replies gossip shard-map "
+            "epochs so a behind server catches up")
+define_flag("PADDLE_PS_HEARTBEAT_TIMEOUT_S", 3.0,
+            "suspicion deadline: a primary whose beats stop for this "
+            "long is declared dead and its first live backup promotes "
+            "itself (shard-map epoch bump + broadcast)")
+define_flag("PADDLE_PS_FAILOVER_RETRIES", 8,
+            "extra client re-route attempts per logical call after a "
+            "stale-map redirect or dead endpoint; paced by "
+            "PADDLE_PS_FAILOVER_BACKOFF_S, the loop must outlast one "
+            "heartbeat timeout + promotion")
+define_flag("PADDLE_PS_FAILOVER_BACKOFF_S", 0.25,
+            "base pause between client failover re-routes (grows "
+            "linearly up to 4x)")

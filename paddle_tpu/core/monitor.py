@@ -7,6 +7,7 @@ pybind/global_value_getter_setter.cc), grown into a typed registry:
 
 - **Counters / gauges** keep the original `stat_add`/`stat_set`/`stats()`
   surface — every existing gauge name (`executor/runs`, `ps.rpc.retries`,
+  `ps.replica.{forwards,promotions,catchups,stale_maps}`,
   `pallas.fallback.*`, `spmd.*`) works unchanged. A counter is any name
   first touched by `stat_add`, a gauge any name first touched by
   `stat_set` — the distinction only matters to the Prometheus export.

@@ -310,17 +310,31 @@ def init_server(tables=None, endpoint=None):
     fleet.init_server building tables out of ps.proto TableParameters;
     here specs are explicit dicts — see distributed.ps.make_table). A
     stop barrier sized to the trainer count is provisioned automatically
-    so stop_worker can rendezvous before servers exit."""
-    from ..ps import PSServer
+    so stop_worker can rendezvous before servers exit.
+
+    With PADDLE_PS_REPLICA_BACKUPS > 0 and a full endpoint list in
+    PADDLE_PSERVERS_IP_PORT_LIST, the server joins the replicated
+    storage tier: every server derives the SAME initial shard map from
+    the endpoint list (chained primary/backup layout), so no bootstrap
+    rendezvous is needed — promotions and rejoins evolve the map from
+    there (distributed/ps/replica.py)."""
+    from ...core.flags import flag as _flag
+    from ..ps import PSServer, ShardMap
+    eps = [e for e in os.environ.get(
+        "PADDLE_PSERVERS_IP_PORT_LIST", "").split(",") if e]
     if endpoint is None:
-        eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "").split(",")
         idx = int(os.environ.get("PADDLE_PSERVER_ID", "0"))
-        endpoint = eps[idx] if eps and eps[0] else "127.0.0.1:0"
+        endpoint = eps[idx] if eps else "127.0.0.1:0"
     tables = dict(tables or {})
     tables.setdefault(_STOP_BARRIER, {
         "type": "barrier",
         "trainer_num": int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))})
-    server = PSServer(endpoint, tables)
+    n_backups = int(_flag("PADDLE_PS_REPLICA_BACKUPS"))
+    replica = None
+    if n_backups > 0 and len(eps) > 1 and ":0" not in endpoint:
+        replica = {"shard_map": ShardMap.create(eps, n_backups),
+                   "peers": eps, "n_backups": n_backups}
+    server = PSServer(endpoint, tables, replica=replica)
     _fleet_state["ps_server"] = server
     server.start()
     return server

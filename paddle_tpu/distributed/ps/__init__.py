@@ -23,15 +23,18 @@ import numpy as np
 
 from .client import Communicator, PSClient
 from .heter import DeviceHashTable, HeterPSCache
-from .rpc import AuthError, DeadlineExceeded, FrameError
+from .replica import ReplicaManager
+from .rpc import AuthError, ConnectRefused, DeadlineExceeded, FrameError
 from .server import PSServer
+from .shard_map import ShardMap, ShardMapStale
 from .table import (BarrierTable, DenseTable, GeoSparseTable, SparseTable,
                     make_table)
 
 __all__ = ["PSServer", "PSClient", "Communicator", "DenseTable",
            "SparseTable", "GeoSparseTable", "BarrierTable", "make_table",
            "SparseEmbedding", "DeviceHashTable", "HeterPSCache",
-           "DeadlineExceeded", "FrameError", "AuthError"]
+           "DeadlineExceeded", "FrameError", "AuthError", "ConnectRefused",
+           "ShardMap", "ShardMapStale", "ReplicaManager"]
 
 
 class SparseEmbedding:

@@ -7,10 +7,21 @@ sections, one RPC per server) and the background-send Communicator
 (operators/distributed/communicator.cc: AsyncCommunicator merges grads in
 queues and flushes every send_wait_times; GeoCommunicator pushes deltas).
 
-Sharding: sparse ids are hashed id % n_servers (same mod rule the
-reference uses for section splitting); dense tables live whole on
-hash(name) % n_servers (dense params here are small relative to the
-sparse vocab — the TPU step owns the real dense math).
+Sharding is owned by a cached, versioned `ShardMap` (shard_map.py):
+sparse ids hash onto shards with `id % n_shards`, dense AND barrier
+tables with `crc32(name) % n_shards`, and every data call routes to the
+shard's PRIMARY, stamped with the map's epoch. Against an unreplicated
+cluster the default map makes this bit-identical to the legacy
+`id % n_servers` rule. Against a replicated cluster the client fails
+over: a `ShardMapStale` redirect installs the server's newer map and
+re-routes; a dead endpoint (ConnectRefused / exhausted transport)
+triggers a map refresh from the surviving servers and a bounded
+re-route loop (`PADDLE_PS_FAILOVER_RETRIES` x
+`PADDLE_PS_FAILOVER_BACKOFF_S`) that rides out a heartbeat-driven
+promotion. Replay ids for mutating calls are minted by the CLIENT (not
+the connection), so the retry that lands on the promoted backup dedupes
+against the forward the dead primary already delivered — exactly-once
+holds across failover, not just across resends.
 """
 from __future__ import annotations
 
@@ -18,14 +29,14 @@ import queue
 import threading
 import time
 import uuid
-import zlib
 
 import numpy as np
 
 from ...core import monitor as _monitor
 from ...core import trace as _trace
 from ...core.flags import flag as _flag
-from .rpc import Connection
+from .rpc import ConnectRefused, Connection
+from .shard_map import ShardMap, ShardMapStale
 
 __all__ = ["PSClient", "Communicator"]
 
@@ -41,60 +52,209 @@ class PSClient:
     # push_* (test doubles with bare push signatures stay valid)
     supports_request_keys = True
 
-    def __init__(self, server_endpoints, **rpc_opts):
+    def __init__(self, server_endpoints, shard_map=None, **rpc_opts):
         if isinstance(server_endpoints, str):
             server_endpoints = server_endpoints.split(",")
         self.endpoints = list(server_endpoints)
-        self._conns = [Connection(ep, **rpc_opts) for ep in self.endpoints]
+        self._rpc_opts = dict(rpc_opts)
+        # one client is shared between the trainer thread and the
+        # Communicator send thread; every _conns read-modify (and any
+        # iteration) holds this lock — Connection.call serializes itself
+        self._conns_lock = threading.Lock()
+        self._conns: dict[str, Connection | None] = {}
+        errors = []
+        for ep in self.endpoints:
+            try:
+                self._conns[ep] = Connection(ep, **rpc_opts)
+            except (ConnectionError, OSError) as e:
+                # a dead member of a replicated cluster must not keep a
+                # fresh worker from joining; the map routes around it.
+                # All-dead still fails loudly below.
+                self._conns[ep] = None
+                errors.append(e)
+        if errors and len(errors) == len(self.endpoints):
+            raise errors[0]
+        # client-owned replay-id namespace: stable across failover
+        # re-routes of one logical call (connection ids are not)
+        self._client_id = uuid.uuid4().hex
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._map_lock = threading.Lock()
+        if shard_map is not None:
+            self._map = shard_map if isinstance(shard_map, ShardMap) \
+                else ShardMap.from_dict(shard_map)
+        else:
+            self._map = ShardMap.default(self.endpoints)
+            self.refresh_shard_map()
+
+    # ----------------------------------------------------------- shard map
+    @property
+    def shard_map(self) -> ShardMap:
+        return self._map
 
     @property
     def n_servers(self):
-        return len(self._conns)
+        return len(self.endpoints)
 
-    def _dense_conn(self, table):
-        # crc32, NOT hash(): str hash is per-process randomized, and every
-        # worker must route a dense table to the same server
-        return self._conns[zlib.crc32(table.encode()) % self.n_servers]
+    def _adopt(self, map_dict):
+        """Install a map if it is newer; newest epoch always wins."""
+        if not map_dict:
+            return False
+        new = ShardMap.from_dict(map_dict)
+        with self._map_lock:
+            if new.epoch <= self._map.epoch:
+                return False
+            self._map = new
+        if new.epoch > 0 or any(new.backups(s)
+                                for s in range(new.n_shards)):
+            self._enable_fail_fast()
+        return True
+
+    def _enable_fail_fast(self):
+        # with backups in the map a refused dial means "fail over NOW",
+        # not "wait out the connect window"
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            if c is not None:
+                c.fail_fast_refused = True
+
+    def refresh_shard_map(self):
+        """Ask every reachable server for its map; adopt the newest.
+        Returns True if the map advanced. Endpoints that were dead at
+        construction (conn is None) are skipped — re-dialing them here
+        would stall every refresh by their connect window; the failover
+        loop re-dials them when the map actually routes there."""
+        advanced = False
+        with self._conns_lock:
+            live = [ep for ep, c in self._conns.items() if c is not None]
+        for ep in live:
+            try:
+                md = self._conn(ep).call("get_shard_map", _timeout=5.0)
+            except (RuntimeError, ConnectionError, OSError):
+                continue
+            if self._adopt(md):
+                advanced = True
+        return advanced
+
+    def _conn(self, ep):
+        with self._conns_lock:
+            c = self._conns.get(ep)
+        if c is not None:
+            return c
+        # re-dial a previously-dead initial endpoint, or dial a server
+        # that joined after this client was built (rejoin on a fresh
+        # endpoint) — short window: failover handles failure. The dial
+        # runs OUTSIDE the lock (it can block for the connect window);
+        # a racing dial for the same endpoint keeps the first winner.
+        c = Connection(ep, **{**self._rpc_opts,
+                              "connect_retry_s": 2.0,
+                              "fail_fast_refused": True})
+        with self._conns_lock:
+            cur = self._conns.get(ep)
+            if cur is not None:
+                won = cur
+            else:
+                won = self._conns[ep] = c
+        if won is not c:
+            c.close()
+        return won
+
+    def _drop_conn(self, ep):
+        with self._conns_lock:
+            c = self._conns.pop(ep, None)
+        if c is not None:
+            c.close()
+
+    def _next_rid(self, key=None):
+        if key is not None:
+            return (self._client_id, key)
+        with self._seq_lock:
+            self._seq += 1
+            return (self._client_id, self._seq)
+
+    def _routed(self, shard, method, _mutating=False, _key=None,
+                _timeout=None, **kw):
+        """One logical call against a shard's primary, riding out stale
+        maps and dead endpoints. The replay id is minted HERE, once, so
+        every re-route of this call carries the same identity."""
+        rid = self._next_rid(_key) if _mutating else None
+        attempts = int(_flag("PADDLE_PS_FAILOVER_RETRIES")) + 1
+        backoff = float(_flag("PADDLE_PS_FAILOVER_BACKOFF_S"))
+        last = None
+        for attempt in range(attempts):
+            m = self._map
+            ep = m.primary(shard)
+            try:
+                return self._conn(ep).call(
+                    method, _mutating=_mutating, _rid=rid,
+                    _timeout=_timeout, __epoch__=m.epoch,
+                    __shard__=int(shard), **kw)
+            except ShardMapStale as e:
+                _monitor.stat_add("ps.replica.stale_maps")
+                last = e
+                if not self._adopt(e.shard_map_dict):
+                    # the server is BEHIND us — teach it our map, then
+                    # retry (it may still be the right primary)
+                    try:
+                        self._conn(ep).call(
+                            "install_shard_map",
+                            shard_map=self._map.to_dict())
+                    except (RuntimeError, ConnectionError, OSError):
+                        pass
+            except (ConnectRefused, ConnectionError, OSError) as e:
+                last = e
+                self._drop_conn(ep)
+                advanced = self.refresh_shard_map()
+                if not advanced and not self._map.backups(shard):
+                    # nowhere to fail over to (unreplicated map, or the
+                    # shard lost its last backup): keep the transport's
+                    # original fail-loud contract
+                    raise
+                if attempt < attempts - 1:
+                    # a promotion needs a heartbeat deadline to pass —
+                    # linear backoff paces the re-route loop across it
+                    time.sleep(backoff * (1 + min(attempt, 3)))
+        raise last
 
     @staticmethod
     def _rkey(request_key, method, table):
         # outer-retry-stable replay key: one merged batch can push several
         # tables (and both dense+sparse of the same name) to one server,
-        # so the method and table disambiguate within the batch key
+        # so the method and table disambiguate within the batch key.
+        # Sharded calls add the shard so each slice applies once.
         return None if request_key is None else (request_key, method, table)
 
     # --------------------------------------------------------------- dense
     def pull_dense(self, table):
-        return self._dense_conn(table).call("pull_dense", table=table)
+        shard = self._map.shard_of_name(table)
+        return self._routed(shard, "pull_dense", table=table)
 
     def push_dense_grad(self, table, grad, request_key=None):
-        self._dense_conn(table).call(
-            "push_dense_grad", _mutating=True,
-            _key=self._rkey(request_key, "pdg", table),
-            table=table, grad=np.asarray(grad, np.float32))
+        shard = self._map.shard_of_name(table)
+        self._routed(shard, "push_dense_grad", _mutating=True,
+                     _key=self._rkey(request_key, "pdg", table),
+                     table=table, grad=np.asarray(grad, np.float32))
 
     def set_dense(self, table, value):
-        self._dense_conn(table).call("set_dense", _mutating=True,
-                                     table=table,
-                                     value=np.asarray(value, np.float32))
+        shard = self._map.shard_of_name(table)
+        self._routed(shard, "set_dense", _mutating=True, table=table,
+                     value=np.asarray(value, np.float32))
 
     # -------------------------------------------------------------- sparse
     def _shard(self, ids):
-        ids = np.asarray(ids, np.int64).reshape(-1)
-        owner = ids % self.n_servers
+        ids, owner = self._map.shard_of_ids(ids)
         return ids, owner
 
     def pull_sparse(self, table, ids):
         """Gather rows for (possibly duplicated) ids; returns
-        [len(ids), dim] in input order."""
+        [len(ids), dim] in input order. Reads always hit the primary."""
         ids, owner = self._shard(ids)
         out = None
-        for s in range(self.n_servers):
+        for s in np.unique(owner):
             mask = owner == s
-            if not mask.any():
-                continue
-            rows = self._conns[s].call("pull_sparse", table=table,
-                                       ids=ids[mask])
+            rows = self._routed(int(s), "pull_sparse", table=table,
+                                ids=ids[mask])
             if out is None:
                 out = np.empty((len(ids), rows.shape[1]), np.float32)
             out[mask] = rows
@@ -105,74 +265,89 @@ class PSClient:
     def push_sparse_grad(self, table, ids, grads, request_key=None):
         ids, owner = self._shard(ids)
         grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
-        for s in range(self.n_servers):
+        for s in np.unique(owner):
             mask = owner == s
-            if mask.any():
-                self._conns[s].call(
-                    "push_sparse_grad", _mutating=True,
-                    _key=self._rkey(request_key, "psg", table),
-                    table=table, ids=ids[mask], grads=grads[mask])
+            key = self._rkey(request_key, "psg", table)
+            self._routed(int(s), "push_sparse_grad", _mutating=True,
+                         _key=None if key is None else key + (int(s),),
+                         table=table, ids=ids[mask], grads=grads[mask])
 
     def push_sparse_delta(self, table, ids, deltas, request_key=None):
         ids, owner = self._shard(ids)
         deltas = np.asarray(deltas, np.float32).reshape(len(ids), -1)
-        for s in range(self.n_servers):
+        for s in np.unique(owner):
             mask = owner == s
-            if mask.any():
-                self._conns[s].call(
-                    "push_sparse_delta", _mutating=True,
-                    _key=self._rkey(request_key, "psd", table),
-                    table=table, ids=ids[mask], deltas=deltas[mask])
+            key = self._rkey(request_key, "psd", table)
+            self._routed(int(s), "push_sparse_delta", _mutating=True,
+                         _key=None if key is None else key + (int(s),),
+                         table=table, ids=ids[mask], deltas=deltas[mask])
 
     # --------------------------------------------------------------- misc
     def barrier(self, table, trainer_id, timeout=120.0):
-        # barrier table lives on server 0 (reference BarrierTable is
-        # likewise singular); the RPC deadline must outlast the barrier's
-        # own server-side wait or every long barrier would look stalled
-        return self._conns[0].call("barrier", _mutating=True,
-                                   _timeout=float(timeout) + 30.0,
-                                   table=table, trainer_id=trainer_id,
-                                   timeout=timeout)
+        # the barrier table routes like a dense table — owned by its
+        # shard's primary (it used to pin server 0: a SPOF the shard map
+        # now owns). The RPC deadline must outlast the barrier's own
+        # server-side wait or every long barrier would look stalled.
+        shard = self._map.shard_of_name(table)
+        return self._routed(shard, "barrier", _mutating=True,
+                            _timeout=float(timeout) + 30.0,
+                            table=table, trainer_id=trainer_id,
+                            timeout=timeout)
 
     def ping(self):
         """Probe every server's transport (pre-auth health method);
-        returns one latency in seconds per server."""
+        returns one latency in seconds per endpoint — None for a dead
+        endpoint instead of raising, so supervisors see per-server
+        health even mid-outage."""
         out = []
-        for c in self._conns:
+        for ep in self.endpoints:
             t0 = time.perf_counter()
-            c.ping()
-            out.append(time.perf_counter() - t0)
+            try:
+                self._conn(ep).ping(timeout=5.0)
+                out.append(time.perf_counter() - t0)
+            except (ConnectionError, OSError):
+                self._drop_conn(ep)
+                out.append(None)
         return out
 
     def table_state(self, table, server=0):
-        return self._conns[server].call("table_state", table=table)
+        return self._server_conn(server).call("table_state", table=table)
 
     def table_applied(self, table, server=0):
         """How many mutating pushes a server's table has APPLIED (replayed
         retries don't count) — the observable for exactly-once tests."""
-        return self._conns[server].call("table_applied", table=table)
+        return self._server_conn(server).call("table_applied", table=table)
+
+    def _server_conn(self, server):
+        return self._conn(self.endpoints[server])
 
     def save_snapshot(self, path):
         """Ask every server to snapshot its tables to server-local disk
         (file per server: {path}.s{i}); mid-train fault tolerance
         (reference large_scale_kv.h checkpointing)."""
-        return [c.call("save_snapshot", path=f"{path}.s{i}")
-                for i, c in enumerate(self._conns)]
+        return [self._server_conn(i).call("save_snapshot",
+                                          path=f"{path}.s{i}")
+                for i in range(len(self.endpoints))]
 
     def load_snapshot(self, path):
-        return [c.call("load_snapshot", path=f"{path}.s{i}")
-                for i, c in enumerate(self._conns)]
+        return [self._server_conn(i).call("load_snapshot",
+                                          path=f"{path}.s{i}")
+                for i in range(len(self.endpoints))]
 
     def stop_servers(self):
-        for c in self._conns:
+        for ep in {*self.endpoints, *self._map.servers}:
             try:
-                c.call("stop")
+                self._conn(ep).call("stop")
             except (ConnectionError, OSError):
                 pass
 
     def close(self):
-        for c in self._conns:
-            c.close()
+        with self._conns_lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            if c is not None:
+                c.close()
 
 
 class Communicator:

@@ -57,7 +57,7 @@ from ...core import trace as _trace
 from ...core.flags import flag as _flag
 
 __all__ = ["send_msg", "recv_msg", "Connection", "serve", "FrameError",
-           "AuthError", "DeadlineExceeded", "ReplayCache",
+           "AuthError", "DeadlineExceeded", "ConnectRefused", "ReplayCache",
            "set_fault_injector"]
 
 _HDR = struct.Struct("!Q")
@@ -79,6 +79,16 @@ class DeadlineExceeded(TimeoutError):
     retry budget. TimeoutError subclass (and therefore OSError), so
     existing `except (ConnectionError, OSError)` cleanup paths catch it.
     """
+
+
+class ConnectRefused(ConnectionError):
+    """The endpoint actively refused the dial — a *dead server* signal,
+    distinct from a transient mid-call failure. Raised immediately (no
+    retry-budget burn) when the fault injector scripts a PARTITION at
+    the dial boundary, or when a real ECONNREFUSED lands on a connection
+    with `fail_fast_refused` set (the shard-map client sets it once a
+    replicated map is live, so a dead primary triggers failover to the
+    promoted backup instead of 30s of redial)."""
 
 
 # --- fault-injection seam (paddle_tpu.testing.faults) --------------------
@@ -226,8 +236,14 @@ class Connection:
     can replay instead of re-applying (see serve/ReplayCache)."""
 
     def __init__(self, endpoint: str, timeout=None, connect_retry_s=None,
-                 max_retries=None, backoff_base=None, backoff_max=None):
+                 max_retries=None, backoff_base=None, backoff_max=None,
+                 fail_fast_refused=False):
         self.endpoint = endpoint
+        # a refused connect normally retries within the connect window
+        # (workers race the server's bind at job start); with a live
+        # replicated shard map the client flips this on so a dead
+        # endpoint raises ConnectRefused immediately and failover runs
+        self.fail_fast_refused = bool(fail_fast_refused)
         self._timeout = float(_flag("PADDLE_PS_CALL_TIMEOUT")
                               if timeout is None else timeout)
         self._max_retries = int(_flag("PADDLE_PS_MAX_RETRIES")
@@ -254,12 +270,29 @@ class Connection:
         job start — the reference's brpc channel does the same via
         connect_timeout + retry policy); an auth REJECTION is final."""
         host, port = self.endpoint.rsplit(":", 1)
+        try:
+            # testing/faults.py PARTITION boundary: a scripted dead or
+            # partitioned endpoint refuses the dial without any real
+            # process being killed
+            _fault("client", "dial", self.endpoint)
+        except ConnectionRefusedError as e:
+            raise ConnectRefused(
+                f"ps rpc: endpoint {self.endpoint} refused connection "
+                "(injected partition)") from e
         deadline = time.monotonic() + connect_retry_s
         while True:
             try:
                 sock = socket.create_connection(
                     (host, int(port)), timeout=self._timeout)
                 break
+            except ConnectionRefusedError as e:
+                if self.fail_fast_refused:
+                    raise ConnectRefused(
+                        f"ps rpc: endpoint {self.endpoint} refused "
+                        "connection") from e
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
             except OSError:
                 if time.monotonic() >= deadline:
                     raise
@@ -291,12 +324,16 @@ class Connection:
 
     # --------------------------------------------------------------- calls
     def call(self, method: str, _mutating=False, _key=None, _timeout=None,
-             **kwargs):
+             _rid=None, **kwargs):
         """One RPC under the retry/deadline policy. `_mutating` stamps a
         replay id; `_key` (optional, any hashable) pins that id so an
         OUTER retry loop (e.g. the Communicator's send thread) stays
-        exactly-once too; `_timeout` overrides the per-attempt deadline
-        (barriers legitimately block longer than data calls)."""
+        exactly-once too; `_rid` overrides the stamped (client_id, key)
+        pair entirely — the shard-map client mints one rid per LOGICAL
+        call so a failover retry to a different server (and a primary's
+        forward to its backups) dedupes against the original apply;
+        `_timeout` overrides the per-attempt deadline (barriers
+        legitimately block longer than data calls)."""
         timeout = self._timeout if _timeout is None else float(_timeout)
         # one span per logical CALL (not per attempt): its context rides
         # in the frame — which is packed once, so every retry/resend
@@ -306,8 +343,8 @@ class Connection:
                           mutating=bool(_mutating))
         t0 = time.perf_counter()
         try:
-            result = self._call_impl(sp, method, _mutating, _key, timeout,
-                                     kwargs)
+            result = self._call_impl(sp, method, _mutating, _key, _rid,
+                                     timeout, kwargs)
             _monitor.observe("ps.rpc/latency_ms",
                              (time.perf_counter() - t0) * 1e3)
             return result
@@ -324,10 +361,12 @@ class Connection:
         finally:
             _trace.end(sp)
 
-    def _call_impl(self, sp, method, _mutating, _key, timeout, kwargs):
+    def _call_impl(self, sp, method, _mutating, _key, _rid, timeout, kwargs):
         req = {"method": method, **kwargs}
         with self._lock:
-            if _mutating:
+            if _rid is not None:
+                req["__rid__"] = tuple(_rid)
+            elif _mutating:
                 if _key is None:
                     self._seq += 1
                     _key = self._seq
@@ -369,6 +408,12 @@ class Connection:
                 except AuthError:
                     self._teardown()
                     raise          # auth rejection is never transient
+                except ConnectRefused:
+                    # dead/partitioned endpoint: this connection cannot
+                    # help — surface immediately so a shard-map client
+                    # fails over instead of burning the retry budget
+                    self._teardown()
+                    raise
                 except (OSError, pickle.UnpicklingError) as e:
                     # covers ConnectionError, FrameError, socket timeout
                     last_err = e
@@ -376,6 +421,14 @@ class Connection:
                     continue
                 sp.attrs["attempts"] = attempt + 1
                 if reply.get("error"):
+                    if reply["error"] == "ShardMapStale":
+                        # structured redirect: the server's map rode
+                        # along, the shard-map client re-routes with it
+                        from .shard_map import ShardMapStale
+                        sp.attrs["error"] = "ShardMapStale"
+                        raise ShardMapStale(reply.get("shard_map"),
+                                            f"{method!r} redirected by "
+                                            f"{self.endpoint}")
                     raise RuntimeError(f"ps server error in {method!r}: "
                                        f"{reply['error']}")
                 return reply.get("result")
@@ -470,6 +523,19 @@ class ReplayCache:
         if entry is not None and entry[0] == self._PENDING:
             entry[1].set()
 
+    def abort(self, rid):
+        """Drop a PENDING entry without caching a reply — used for
+        routing rejections (ShardMapStale): the client WILL retry the
+        same rid against the right server, and a cached redirect would
+        replay forever. Parked retries are woken; begin() then hands
+        them 'run'."""
+        cid, seq = rid
+        with self._lock:
+            entries = self._clients.get(cid)
+            entry = entries.pop(seq, None) if entries is not None else None
+        if entry is not None and entry[0] == self._PENDING:
+            entry[1].set()
+
     def lookup(self, rid):
         cid, seq = rid
         with self._lock:
@@ -503,7 +569,7 @@ def _rid_of(req):
     return str(cid), seq
 
 
-def serve(endpoint: str, handler, stop_event: threading.Event):
+def serve(endpoint: str, handler, stop_event: threading.Event, replay=None):
     """Accept loop: one daemon thread per connection, each dispatching
     framed requests to handler(method, kwargs) until the peer closes or
     stop_event fires. Returns the bound port (endpoint may say :0).
@@ -513,7 +579,14 @@ def serve(endpoint: str, handler, stop_event: threading.Event):
     connection (the stream past it is desynced) — the server and its
     other connections keep running. `__ping__` is answered before auth.
     Requests carrying a replay id go through the shared ReplayCache so a
-    retried mutation is applied exactly once."""
+    retried mutation is applied exactly once; pass `replay` to share the
+    cache with other machinery (the replica catch-up path registers
+    delta-log rids in it so live forwards dedupe against them).
+
+    A handler declaring a third parameter — handler(method, req, rid) —
+    receives the request's replay id so it can thread the SAME id through
+    primary->backup forwards (exactly-once across the whole replica
+    chain); two-parameter handlers keep working unchanged."""
     host, port = endpoint.rsplit(":", 1)
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -523,7 +596,14 @@ def serve(endpoint: str, handler, stop_event: threading.Event):
     bound = srv.getsockname()[1]
 
     token = os.environ.get("PADDLE_PS_TOKEN")
-    replay = ReplayCache()
+    if replay is None:
+        replay = ReplayCache()
+    try:
+        import inspect
+        _sig = inspect.signature(handler)
+        wants_rid = len(_sig.parameters) >= 3
+    except (TypeError, ValueError):
+        wants_rid = False
 
     def _serve_one(conn, method, req):
         """Run the handler (through the replay cache when the request is
@@ -538,36 +618,63 @@ def serve(endpoint: str, handler, stop_event: threading.Event):
                           outcome="apply")
         try:
             reply = None
+            run = rid is None
             if rid is not None:
-                state, payload = replay.begin(rid)
-                if state == "replay":
-                    _monitor.stat_add("ps.rpc.replays")
-                    sp.attrs["outcome"] = "replay"
-                    reply = payload
-                elif state == "wait":
+                for _round in range(3):
+                    state, payload = replay.begin(rid)
+                    if state == "run":
+                        run = True
+                        break
+                    if state == "replay":
+                        _monitor.stat_add("ps.rpc.replays")
+                        sp.attrs["outcome"] = "replay"
+                        reply = payload
+                        break
                     # the original attempt is still executing on another
                     # connection thread — parking beats double-applying
                     sp.attrs["outcome"] = "wait"
                     payload.wait(timeout=600.0)
                     reply = replay.lookup(rid)
-                    if reply is None:
-                        reply = {"error": "ps rpc: in-flight original "
-                                          "never committed (server "
-                                          "overloaded?)"}
-                    else:
+                    if reply is not None:
                         _monitor.stat_add("ps.rpc.replays")
-            if reply is None:
+                        break
+                    # original aborted (stale-map redirect) or evicted:
+                    # loop to re-begin — this retry becomes the runner
+                if not run and reply is None:
+                    reply = {"error": "ps rpc: in-flight original "
+                                      "never committed (server "
+                                      "overloaded?)"}
+            if run:
+                cacheable = True
                 try:
-                    result = handler(method, req)
+                    result = handler(method, req, rid) if wants_rid \
+                        else handler(method, req)
                     reply = {"result": result}
                 except Exception as e:  # noqa: BLE001 — reported to peer
                     sp.attrs["error"] = type(e).__name__
-                    reply = {"error": f"{type(e).__name__}: {e}"}
+                    stale = getattr(e, "shard_map_dict", None)
+                    if stale is not None:
+                        # routing redirect, not an application error:
+                        # ship the server's map and DON'T cache — the
+                        # same rid must run for real on the right server
+                        reply = {"error": "ShardMapStale",
+                                 "shard_map": stale}
+                        cacheable = False
+                    else:
+                        reply = {"error": f"{type(e).__name__}: {e}"}
+                        if getattr(e, "replay_uncacheable", False):
+                            # e.g. a quorum failure: the error must not
+                            # poison the rid — the retry re-runs (the
+                            # replica layer dedupes the apply itself)
+                            cacheable = False
                 if rid is not None:
                     # commit BEFORE the reply leaves: if the response is
                     # lost from here on, the retry replays instead of
                     # re-applying
-                    replay.commit(rid, reply)
+                    if cacheable:
+                        replay.commit(rid, reply)
+                    else:
+                        replay.abort(rid)
         finally:
             _trace.end(sp)
         try:

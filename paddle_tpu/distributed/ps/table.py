@@ -20,6 +20,7 @@ Design deltas (SURVEY.md §2.1 N20-N22, hard part 5):
 from __future__ import annotations
 
 import threading
+import zlib
 
 import numpy as np
 
@@ -76,17 +77,48 @@ _ACCESSORS = {
 }
 
 
+def _splitmix64(x):
+    """Vectorized splitmix64 over uint64 arrays (wrapping arithmetic)."""
+    x = x + np.uint64(0x9E3779B97F4A7C15)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
 def _initializer(kind, dim, seed):
-    rng = np.random.RandomState(seed)
+    """Per-ID deterministic row initializer: rows(ids) -> [len(ids), dim].
+
+    A row's initial value is a pure function of (seed, id, column) — a
+    counter-based hash stream, not a shared sequential RNG. That makes
+    materialization ORDER-INDEPENDENT, which the replicated storage tier
+    requires: a promoted backup (or a rejoined server) materializes a
+    never-pushed row on first pull, and it must get bit-identical values
+    to the row the dead primary would have served, no matter how many
+    rows either side created in between."""
     if kind == "zeros":
-        return lambda n: np.zeros((n, dim), np.float32)
-    if kind == "uniform":
-        scale = 1.0 / np.sqrt(dim)
-        return lambda n: rng.uniform(-scale, scale, (n, dim)).astype(
-            np.float32)
-    if kind == "normal":
-        return lambda n: (rng.randn(n, dim) * 0.01).astype(np.float32)
-    raise ValueError(f"unknown initializer {kind!r}")
+        return lambda ids: np.zeros((len(ids), dim), np.float32)
+    if kind not in ("uniform", "normal"):
+        raise ValueError(f"unknown initializer {kind!r}")
+    base = np.uint64(seed) * np.uint64(0x2545F4914F6CDD1D) \
+        ^ np.uint64(zlib.crc32(kind.encode()))
+
+    def rows(ids):
+        ids_u = np.asarray(ids, np.int64).reshape(-1, 1).view(np.uint64)
+        cols = np.arange(dim, dtype=np.uint64).reshape(1, -1)
+        h = _splitmix64(ids_u * np.uint64(0x100000001B3) ^ cols ^ base)
+        # top 53 bits -> uniform [0, 1)
+        u = (h >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))
+        if kind == "uniform":
+            scale = 1.0 / np.sqrt(dim)
+            return ((u * 2.0 - 1.0) * scale).astype(np.float32)
+        # normal: Box-Muller from two independent hash streams
+        h2 = _splitmix64(h ^ np.uint64(0xD6E8FEB86659FD93))
+        u2 = (h2 >> np.uint64(11)).astype(np.float64) * (1.0 / (1 << 53))
+        u = np.maximum(u, 2.0 ** -53)          # log(0) guard
+        z = np.sqrt(-2.0 * np.log(u)) * np.cos(2.0 * np.pi * u2)
+        return (z * 0.01).astype(np.float32)
+
+    return rows
 
 
 # ------------------------------------------------------------------ tables
@@ -200,7 +232,7 @@ class SparseTable:
 
             self._data = grow(self._data)
             self._slots = {k: grow(v) for k, v in self._slots.items()}
-        self._data[base:need] = self._init_rows(len(missing))
+        self._data[base:need] = self._init_rows(missing)
         fresh = self._slot_init(len(missing))
         for k in self._slots:
             self._slots[k][base:need] = fresh[k]
@@ -251,14 +283,27 @@ class SparseTable:
                                        for k in self._slots}
                               for i, pos in self._index.items()}}
 
-    def load_state(self, st):
+    def load_state(self, st, merge=False):
+        """merge=False resets the table to exactly `st`; merge=True
+        UPSERTS `st`'s rows over the existing ones (rows absent from
+        `st` keep their values) — the replica catch-up path merges one
+        shard's rows at a time without clobbering rows it already holds
+        for other shards."""
         with self._lock:
             ids = [int(i) for i in st["ids"]]
-            self._index = {i: pos for pos, i in enumerate(ids)}
-            # np.array copies — see DenseTable.load_state
-            self._data = np.array(st["values"], np.float32).reshape(
-                len(ids), self.dim)
-            self._slots = self._slot_init(len(ids))
+            if merge:
+                self._ensure(ids)
+                if ids:
+                    idx = self._idx(ids)
+                    self._data[idx] = np.array(
+                        st["values"], np.float32).reshape(len(ids),
+                                                          self.dim)
+            else:
+                self._index = {i: pos for pos, i in enumerate(ids)}
+                # np.array copies — see DenseTable.load_state
+                self._data = np.array(st["values"], np.float32).reshape(
+                    len(ids), self.dim)
+                self._slots = self._slot_init(len(ids))
             for i, s in (st.get("slots", {}) or {}).items():
                 pos = self._index.get(int(i))
                 if pos is None:

@@ -1,8 +1,11 @@
 """Deterministic fault injection for the PS transport.
 
 The rpc layer (distributed/ps/rpc.py) consults a process-global injector
-at three frame boundaries:
+at four boundaries:
 
+    ("client", "dial", endpoint) before a (re)connect — note the third
+                                 field is the ENDPOINT, not a method, so
+                                 rules can target one server
     ("client", "send", method)   before the request frame leaves
     ("client", "recv", method)   after send, before reading the reply
     ("server", "reply", method)  after the handler ran AND the replay
@@ -16,15 +19,25 @@ seed (sha-based, independent of PYTHONHASHSEED and thread interleaving
 within each stream), for chaos runs.
 
 Actions:
-    RESET      raise ConnectionResetError at the boundary (any site).
-               Client side it models a TCP RST before/after the send;
-               server side the reply path closes the connection.
+    RESET      raise ConnectionResetError at the boundary (any site
+               except dial). Client side it models a TCP RST before/
+               after the send; server side the reply path closes the
+               connection.
     DROP       server reply only: the request WAS applied, the response
                is lost — the case idempotent replay exists for.
     STALL      sleep `delay` seconds at the boundary (models a hung
                peer; pair with a small PADDLE_PS_CALL_TIMEOUT).
     GARBLE     server reply only: a well-framed garbage payload.
     OVERSIZE   server reply only: a length prefix over the frame bound.
+    PARTITION  client dial only: the (re)connect is refused —
+               rpc.ConnectRefused — which is how a PERMANENTLY dead or
+               partitioned server looks at dial time, distinct from a
+               RESET mid-call. Target one endpoint with
+               `method="host:port"` (times=N keeps it refused for N
+               dials) to script dead-server and split-brain scenarios
+               without killing real processes; combine with RESET rules
+               on the data methods to sever already-established
+               connections too.
 
 Usage:
 
@@ -48,14 +61,15 @@ import time
 
 from ..distributed.ps import rpc as _rpc
 
-__all__ = ["RESET", "DROP", "STALL", "GARBLE", "OVERSIZE", "Fault",
-           "FaultInjector", "inject", "install", "uninstall"]
+__all__ = ["RESET", "DROP", "STALL", "GARBLE", "OVERSIZE", "PARTITION",
+           "Fault", "FaultInjector", "inject", "install", "uninstall"]
 
 RESET = "reset"
 DROP = "drop"
 STALL = "stall"
 GARBLE = "garble"
 OVERSIZE = "oversize"
+PARTITION = "partition"
 
 # actions that only make sense where the reply frame is produced
 _SERVER_REPLY_ONLY = frozenset({DROP, GARBLE, OVERSIZE})
@@ -64,6 +78,11 @@ _SERVER_REPLY_ONLY = frozenset({DROP, GARBLE, OVERSIZE})
 def _eligible(action, side, event):
     if action in _SERVER_REPLY_ONLY:
         return side == "server" and event == "reply"
+    if action == PARTITION:
+        return side == "client" and event == "dial"
+    if event == "dial":
+        # the only fault a dial can exhibit is a refused connect
+        return False
     return True
 
 
@@ -114,7 +133,8 @@ class FaultInjector:
         self._counts = {}
         self._lock = threading.Lock()
         for action in self.p:
-            if action not in (RESET, DROP, STALL, GARBLE, OVERSIZE):
+            if action not in (RESET, DROP, STALL, GARBLE, OVERSIZE,
+                              PARTITION):
                 raise ValueError(f"unknown fault action {action!r}")
 
     def _draw(self, side, event, method):
@@ -161,6 +181,10 @@ class FaultInjector:
         if action == RESET:
             raise ConnectionResetError(
                 f"fault injected: reset at {side}/{event} of {method!r}")
+        if action == PARTITION:
+            # rpc.Connection._dial converts this into ConnectRefused
+            raise ConnectionRefusedError(
+                f"fault injected: partitioned endpoint {method}")
         return action
 
     def fired(self, action=None):

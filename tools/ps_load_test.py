@@ -8,6 +8,22 @@ pickle-frames-over-TCP transport on one host and records the number
 in docs/ps_throughput.md so regressions are visible.
 
 Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/ps_load_test.py
+
+Modes (env):
+  PS_LOAD_CHAOS=<seed>  throughput UNDER seeded resets + dropped replies
+                        (the retry/replay path's overhead).
+  PS_LOAD_FAILOVER=1    replicated-storage failover drill: a 3-server /
+                        1-backup cluster under worker load, one primary
+                        killed mid-run; reports promotion latency, the
+                        ps.replica.* counters, and rows/sec through the
+                        outage. Workers must finish with zero errors —
+                        the live proof behind docs/fault_tolerance.md's
+                        storage-tier section.
+
+framework_lint TOOL_CROSS_CHECKS runs self_check() here: the
+PADDLE_PS_REPLICA_*/PADDLE_PS_HEARTBEAT_*/PADDLE_PS_FAILOVER_* flag
+defaults, this tool's failover-mode knobs, and docs/fault_tolerance.md
+must agree.
 """
 import os
 import sys
@@ -28,6 +44,25 @@ WORKERS = int(os.environ.get("PS_LOAD_WORKERS", 4))
 ROUNDS = int(os.environ.get("PS_LOAD_ROUNDS", 50))
 BATCH_IDS = int(os.environ.get("PS_LOAD_BATCH", 2048))
 
+# failover-drill knobs (PS_LOAD_FAILOVER mode); the heartbeat pair is
+# deliberately faster than the PADDLE_PS_HEARTBEAT_* prod defaults —
+# self_check() pins BOTH against docs/fault_tolerance.md
+FAILOVER_SERVERS = int(os.environ.get("PS_LOAD_SERVERS", 3))
+FAILOVER_HB_S = float(os.environ.get("PS_LOAD_HB_S", 0.1))
+FAILOVER_HB_TIMEOUT_S = float(os.environ.get("PS_LOAD_HB_TIMEOUT_S", 0.7))
+
+# flag defaults this tool (and the docs flag table) are written against;
+# drift here means docs/fault_tolerance.md + this header need an update
+REPLICA_FLAG_DEFAULTS = {
+    "PADDLE_PS_REPLICA_BACKUPS": 0,
+    "PADDLE_PS_REPLICA_QUORUM": 0,
+    "PADDLE_PS_REPLICA_DELTA_LOG": 512,
+    "PADDLE_PS_HEARTBEAT_S": 0.5,
+    "PADDLE_PS_HEARTBEAT_TIMEOUT_S": 3.0,
+    "PADDLE_PS_FAILOVER_RETRIES": 8,
+    "PADDLE_PS_FAILOVER_BACKOFF_S": 0.25,
+}
+
 
 def run_worker(endpoints, wid, results):
     client = PSClient(endpoints)
@@ -46,7 +81,135 @@ def run_worker(endpoints, wid, results):
     client.close()
 
 
+def run_failover():
+    """PS_LOAD_FAILOVER: kill-and-promote under load. Reports the
+    promotion latency (kill -> ps.replica.promotions tick), replica
+    counters, and aggregate rows/sec through the outage."""
+    from paddle_tpu.core import monitor
+    from paddle_tpu.distributed.ps import ShardMap
+
+    spec = {"emb": {"type": "sparse", "dim": DIM, "optimizer": "sgd",
+                    "lr": 0.1, "init": "zeros"}}
+    servers = [PSServer("127.0.0.1:0", dict(spec))
+               for _ in range(FAILOVER_SERVERS)]
+    eps = [s.start() for s in servers]
+    smap = ShardMap.create(eps, n_backups=1)
+    fast = dict(timeout=5.0, max_retries=2, backoff_base=0.01,
+                backoff_max=0.05)
+    for s in servers:
+        s.enable_replication(shard_map=smap, peers=eps, n_backups=1,
+                             heartbeat_s=FAILOVER_HB_S,
+                             heartbeat_timeout_s=FAILOVER_HB_TIMEOUT_S,
+                             rpc_opts=dict(fast))
+
+    errors = []
+    results = {}
+
+    def worker(wid):
+        client = PSClient(eps, **fast)
+        rng = np.random.RandomState(wid)
+        pushed = 0
+        t0 = time.perf_counter()
+        try:
+            for _ in range(ROUNDS):
+                ids = np.unique(rng.randint(0, VOCAB, BATCH_IDS)
+                                .astype(np.int64))
+                rows = client.pull_sparse("emb", ids)
+                client.push_sparse_grad(
+                    "emb", ids, np.asarray(rows, np.float32) * 0 + 0.01)
+                pushed += len(ids)
+        except Exception as e:  # noqa: BLE001 — reported below
+            errors.append(f"worker {wid}: {type(e).__name__}: {e}")
+        results[wid] = (pushed, time.perf_counter() - t0)
+        client.close()
+
+    promotions0 = monitor.stat_get("ps.replica.promotions")
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(WORKERS)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    t_kill = time.perf_counter()
+    servers[0].shutdown()                 # permanent primary kill
+    promote_latency = None
+    while time.perf_counter() - t_kill < 30.0:
+        if monitor.stat_get("ps.replica.promotions") > promotions0:
+            promote_latency = time.perf_counter() - t_kill
+            break
+        time.sleep(0.005)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    for s in servers[1:]:
+        s.shutdown()
+
+    total = sum(r[0] for r in results.values())
+    replica = {k: int(v) for k, v in
+               sorted(monitor.stats("ps.replica.").items())}
+    print(f"failover drill: {FAILOVER_SERVERS} servers, 1 backup, "
+          f"{WORKERS} workers x {ROUNDS} rounds, primary killed at 0.5s")
+    print(f"promotion latency: "
+          f"{'NONE RECORDED' if promote_latency is None else f'{promote_latency * 1000:.0f}ms'}"
+          f" (heartbeat {FAILOVER_HB_S}s, deadline "
+          f"{FAILOVER_HB_TIMEOUT_S}s)")
+    print(f"rows pushed through the outage: {total:,} "
+          f"({total / wall:,.0f} rows/sec aggregate)")
+    print(f"replica counters: {replica}")
+    if errors:
+        print("worker errors:\n  " + "\n  ".join(errors))
+        return 1
+    if promote_latency is None:
+        print("ERROR: no promotion was recorded")
+        return 1
+    print("all workers finished with zero errors")
+    return 0
+
+
+def self_check():
+    """framework_lint cross-check: flag defaults <-> this tool's knobs
+    <-> docs/fault_tolerance.md. Returns a list of violations."""
+    problems = []
+    from paddle_tpu.core import flags as _flags
+    for name, want in REPLICA_FLAG_DEFAULTS.items():
+        defn = _flags._DEFS.get(name)
+        if defn is None:
+            problems.append(f"ps_load_test: flag {name} is no longer "
+                            "defined in core/flags.py")
+            continue
+        if defn[1] != want:
+            problems.append(
+                f"ps_load_test: {name} default drifted "
+                f"({defn[1]!r} != {want!r}) — update "
+                "REPLICA_FLAG_DEFAULTS and docs/fault_tolerance.md")
+    doc_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "fault_tolerance.md")
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError as e:
+        return problems + [f"ps_load_test: cannot read {doc_path}: {e}"]
+    for name in REPLICA_FLAG_DEFAULTS:
+        if name not in doc:
+            problems.append(f"ps_load_test: flag {name} is not "
+                            "documented in docs/fault_tolerance.md")
+    if "PS_LOAD_FAILOVER" not in doc:
+        problems.append("ps_load_test: the PS_LOAD_FAILOVER drill is not "
+                        "documented in docs/fault_tolerance.md")
+    for token in (f"heartbeat_s={FAILOVER_HB_S}",
+                  f"heartbeat_timeout_s={FAILOVER_HB_TIMEOUT_S}"):
+        if token not in doc:
+            problems.append(
+                f"ps_load_test: docs/fault_tolerance.md no longer states "
+                f"the drill timing `{token}` — keep the doc's failover "
+                "timeline in sync with PS_LOAD_HB_S/PS_LOAD_HB_TIMEOUT_S")
+    return problems
+
+
 def main():
+    if os.environ.get("PS_LOAD_FAILOVER"):
+        return run_failover()
     srv = PSServer(tables={
         "emb": {"type": "sparse", "dim": DIM, "optimizer": "sgd",
                 "lr": 0.1, "init": "zeros"}})
