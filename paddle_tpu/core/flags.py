@@ -240,3 +240,31 @@ define_flag("PADDLE_PS_FAILOVER_RETRIES", 8,
 define_flag("PADDLE_PS_FAILOVER_BACKOFF_S", 0.25,
             "base pause between client failover re-routes (grows "
             "linearly up to 4x)")
+
+# --- trainer-side fault tolerance (incubate/checkpoint.py,
+# --- distributed/elastic.py Supervisor, distributed/launch.py --elastic) --
+define_flag("PADDLE_CKPT_VERIFY", True,
+            "verify checkpoint manifests (per-leaf sha256 + shape/dtype "
+            "schema) on restore; a corrupt/partial/schema-mismatched "
+            "step is quarantined and restore walks back to the newest "
+            "VERIFIED checkpoint instead of loading garbage. Off, the "
+            "manifest is still written but restore trusts the data")
+define_flag("PADDLE_ELASTIC_MAX_RESTARTS", 3,
+            "per-trainer restart budget of the elastic supervisor "
+            "(distributed/elastic.py Supervisor / launch.py --elastic); "
+            "a rank that dies or stalls more than this many times fails "
+            "the whole job with the child's exit status")
+define_flag("PADDLE_ELASTIC_RESTART_BACKOFF_S", 1.0,
+            "base pause before an elastic trainer restart; grows "
+            "linearly with that rank's restart count so a crash loop "
+            "cannot hot-spin the supervisor")
+define_flag("PADDLE_ELASTIC_STALL_TIMEOUT_S", 300.0,
+            "supervisor-side stall deadline: a trainer whose heartbeat "
+            "file keeps beating but whose step counter has not advanced "
+            "for this long is flight-recorded, killed, and restarted "
+            "(a hung collective or starved input pipeline looks exactly "
+            "like this)")
+define_flag("PADDLE_ELASTIC_HEARTBEAT_TIMEOUT_S", 60.0,
+            "supervisor-side liveness deadline: a trainer whose "
+            "heartbeat file is older than this (or unreadable) is "
+            "declared dead and restarted")

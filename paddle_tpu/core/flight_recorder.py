@@ -37,7 +37,8 @@ from . import monitor as _monitor
 from . import trace as _trace
 
 __all__ = ["dump", "dump_dir", "enabled", "suppressed", "maybe_install",
-           "install_signal_handlers", "SCHEMA_VERSION", "SCHEMA_KEYS"]
+           "install_signal_handlers", "register_emergency_hook",
+           "unregister_emergency_hook", "SCHEMA_VERSION", "SCHEMA_KEYS"]
 
 SCHEMA_VERSION = 1
 # tools/obs_report.py renders exactly these sections; its self_check()
@@ -132,11 +133,54 @@ def record(reason: str, exc=None, extra=None) -> dict:
     }
 
 
-def dump(reason: str, exc=None, extra=None):
+# Emergency hooks: callables fired when a dump is requested for one of
+# their reasons, INDEPENDENT of PADDLE_TPU_DUMP_DIR — the checkpoint
+# tier's emergency synchronous save (incubate/checkpoint.py) rides the
+# same trigger points as the recorder (PipelineStepError, SIGTERM)
+# whether or not post-mortem dumps are configured. Each hook is
+# (reasons, fn); fn(reason, exc) must never raise consequentially —
+# failures are swallowed so a broken hook cannot mask the failure that
+# fired it.
+_emergency_hooks: list = []
+
+
+def register_emergency_hook(fn, reasons=("pipeline_step_error",
+                                         "signal_SIGTERM")):
+    """Run `fn(reason, exc)` whenever a dump fires for one of `reasons`
+    (even with the dump dir unset). Returns the hook handle for
+    unregister_emergency_hook."""
+    handle = (tuple(reasons), fn)
+    with _lock:
+        _emergency_hooks.append(handle)
+    return handle
+
+
+def unregister_emergency_hook(handle):
+    with _lock:
+        try:
+            _emergency_hooks.remove(handle)
+        except ValueError:
+            pass
+
+
+def _fire_emergency_hooks(reason, exc):
+    with _lock:
+        hooks = [fn for reasons, fn in _emergency_hooks
+                 if reason in reasons]
+    for fn in hooks:
+        try:
+            fn(reason, exc)
+        except Exception:
+            pass
+
+
+def dump(reason: str, exc=None, extra=None, _fire_hooks=True):
     """Write a flight-recorder dump; returns the path, or None when
     disabled/rate-limited. NEVER raises — a recorder failure must not
     mask the failure being recorded."""
     try:
+        if _fire_hooks and not _is_suppressed(reason):
+            _fire_emergency_hooks(reason, exc)
         d = dump_dir()
         if not d or _is_suppressed(reason):
             return None
@@ -169,9 +213,18 @@ def _handler(signum, frame):
     # dump from the handler itself could deadlock on them; a side thread
     # either gets the locks when their holders release, or we give up at
     # the timeout and die dump-less. Best-effort by design.
-    th = threading.Thread(
-        target=dump, args=(f"signal_{signal.Signals(signum).name}",),
-        daemon=True)
+    #
+    # Emergency hooks (the checkpoint tier's synchronous grace save) run
+    # FIRST, on the main thread, unbounded: the interrupted main thread
+    # owns the model/optimizer state they capture, and a save that takes
+    # longer than any fixed bound must complete rather than be killed
+    # mid-write — delaying death is their entire purpose. Only the
+    # metrics/trace dump rides the bounded side thread.
+    reason = f"signal_{signal.Signals(signum).name}"
+    if not _is_suppressed(reason):
+        _fire_emergency_hooks(reason, None)
+    th = threading.Thread(target=dump, args=(reason,),
+                          kwargs={"_fire_hooks": False}, daemon=True)
     th.start()
     th.join(timeout=10.0)
     prev = _prev_handlers.get(signum)

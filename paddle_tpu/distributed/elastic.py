@@ -11,38 +11,97 @@ process mid-step — recovery is restart-from-checkpoint, which
 incubate/checkpoint.py makes exact. What belongs HERE is detection and
 supervision: a heartbeat any watcher can read, a stall monitor that fires
 a callback when training stops progressing (hung collective, dead input
-pipeline), and launcher-side restart of failed trainers
-(distributed/launch.py --elastic), which resume via auto-checkpoint.
+pipeline), and the `Supervisor` — the launcher-side loop
+(distributed/launch.py --elastic) that kills and restarts individual
+trainers on death, heartbeat loss, or stalled progress, with backoff and
+a PADDLE_ELASTIC_MAX_RESTARTS budget. Restarted trainers resume exactly
+via the verified auto-checkpoint tier.
+
+The training loops (hapi fit, Executor.train_from_dataset, the
+PipelineRunner hot loop) call `notify_step()` once per completed step;
+every started StallMonitor and Heartbeat registers itself as a listener,
+so liveness reflects REAL progress instead of a stale counter.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import threading
 import time
 from typing import Callable, Optional
 
-__all__ = ["Heartbeat", "StallMonitor"]
+__all__ = ["Heartbeat", "StallMonitor", "Supervisor", "notify_step"]
+
+# Started StallMonitor/Heartbeat instances; notify_step() fans the
+# training loops' per-step pulse out to them. A plain set + lock — the
+# pulse is one dict lookup when nothing is registered.
+_listeners_lock = threading.Lock()
+_listeners: set = set()
+
+
+def notify_step(step=None):
+    """One completed training step: refresh every active StallMonitor /
+    Heartbeat. Called by the training hot loops (hapi fit,
+    Executor.train_from_dataset, PipelineRunner.submit*)."""
+    if not _listeners:
+        return
+    with _listeners_lock:
+        targets = list(_listeners)
+    for t in targets:
+        try:
+            t.step_done(step)
+        except Exception:
+            pass
+
+
+def _register(listener):
+    with _listeners_lock:
+        _listeners.add(listener)
+
+
+def _unregister(listener):
+    with _listeners_lock:
+        _listeners.discard(listener)
 
 
 class Heartbeat:
     """Periodic liveness file: {dir}/heartbeat_{rank}.json holding rank,
     step, timestamp (the HeartBeatMonitor's UPDATE side; any supervisor —
-    the launcher, an operator, a dashboard — is the CHECK side)."""
+    the launcher, an operator, a dashboard — is the CHECK side).
 
-    def __init__(self, directory, rank=None, interval_s=10.0):
+    The beat thread writes the LIVE step: `step_fn` (a callable returning
+    the current global step) wins, else the shared counter refreshed by
+    `notify_step()` / `update(step=...)` — a beat between update() calls
+    no longer re-writes a stale step."""
+
+    def __init__(self, directory, rank=None, interval_s=10.0,
+                 step_fn: Optional[Callable[[], int]] = None):
         from .env import get_rank
         os.makedirs(directory, exist_ok=True)
         self.rank = get_rank() if rank is None else rank
         self.path = os.path.join(directory, f"heartbeat_{self.rank}.json")
         self.interval_s = interval_s
         self._step = 0
+        self._step_fn = step_fn
         self._stop = threading.Event()
         self._thread = None
+
+    def step_done(self, step=None):
+        """notify_step() listener: training advanced one step."""
+        if step is not None:
+            self._step = int(step)
+        else:
+            self._step += 1
 
     def update(self, step=None):
         if step is not None:
             self._step = int(step)
+        if self._step_fn is not None:
+            try:
+                self._step = int(self._step_fn())
+            except Exception:
+                pass
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"rank": self.rank, "step": self._step,
@@ -56,28 +115,23 @@ class Heartbeat:
         self.update()
         self._thread = threading.Thread(target=beat, daemon=True)
         self._thread.start()
+        _register(self)
         return self
 
     def stop(self):
         self._stop.set()
+        _unregister(self)
 
     @staticmethod
-    def check(directory, timeout_s=60.0):
-        """Supervisor side: ranks whose heartbeat is stale (dead/hung).
-
-        Never raises on bad beat files: the supervisor is the one process
-        that must outlive everything else, and a trainer dying mid-write
-        (or a vanished file, or a corrupted disk) is exactly the moment
-        it's needed. A heartbeat that can't be read or parsed counts as
-        STALE — liveness must be proven, not assumed."""
-        now = time.time()
-        stale = []
+    def read(directory):
+        """Supervisor side: {rank: {"step", "time"}} for every readable
+        committed beat file; unreadable/corrupt files map to None."""
+        out = {}
         try:
             names = sorted(os.listdir(directory))
         except OSError:
-            return []   # directory gone: nothing provably alive OR dead
+            return out
         for name in names:
-            # only committed beat files; skips the atomic-write .tmp twin
             if not (name.startswith("heartbeat_")
                     and name.endswith(".json")):
                 continue
@@ -88,35 +142,72 @@ class Heartbeat:
             try:
                 with open(os.path.join(directory, name)) as f:
                     rec = json.load(f)
-                beat_time = float(rec["time"])
-                rank = int(rec.get("rank", rank))
+                out[int(rec.get("rank", rank))] = {
+                    "step": int(rec.get("step", 0)),
+                    "time": float(rec["time"])}
             except (OSError, ValueError, KeyError, TypeError):
+                out[rank] = None
+        return out
+
+    @staticmethod
+    def check(directory, timeout_s=60.0):
+        """Supervisor side: ranks whose heartbeat is stale (dead/hung).
+
+        Never raises on bad beat files: the supervisor is the one process
+        that must outlive everything else, and a trainer dying mid-write
+        (or a vanished file, or a corrupted disk) is exactly the moment
+        it's needed. A heartbeat that can't be read or parsed counts as
+        STALE — liveness must be proven, not assumed. Publishes the
+        oldest readable beat's age as the `elastic.heartbeat_age_s`
+        gauge."""
+        from ..core import monitor as _monitor
+        now = time.time()
+        stale, ages = [], []
+        for rank, rec in Heartbeat.read(directory).items():
+            if rec is None:
                 # corrupt / partial / vanished mid-check → stale rank
                 stale.append(rank)
                 continue
-            if now - beat_time > timeout_s:
+            age = now - rec["time"]
+            ages.append(age)
+            if age > timeout_s:
                 stale.append(rank)
+        if ages:
+            _monitor.stat_set("elastic.heartbeat_age_s", max(ages))
         return stale
+
+
+def _default_on_stall(dt):
+    """A stall is a failure in progress: count it, flight-record the
+    span/metric history (the stall's only timeline — no exception will
+    ever carry it), and warn."""
+    from ..core import flight_recorder as _fr
+    from ..core import monitor as _monitor
+    _monitor.stat_add("elastic.stalls")
+    _fr.dump("stall", extra={"stalled_s": dt})
+    print(f"[paddle_tpu] WARNING: no training step for {dt:.0f}s — "
+          "hung collective or starved input pipeline?", flush=True)
 
 
 class StallMonitor:
     """Fires `on_stall` when no step completes for `timeout_s` — a hung
     collective or dead input pipeline looks exactly like this (the
-    reference's heartbeat CHECK loop, heart_beat_monitor.cc:?? applied to
-    single-controller training)."""
+    reference's heartbeat CHECK loop, heart_beat_monitor.cc applied to
+    single-controller training). Started monitors register as
+    `notify_step()` listeners, so the training loops feed them without
+    holding a reference. The default `on_stall` bumps `elastic.stalls`
+    and writes a flight-recorder dump (reason=stall)."""
 
     def __init__(self, timeout_s=300.0,
                  on_stall: Optional[Callable[[float], None]] = None):
         self.timeout_s = timeout_s
-        self.on_stall = on_stall or (lambda dt: print(
-            f"[paddle_tpu] WARNING: no training step for {dt:.0f}s — "
-            "hung collective or starved input pipeline?", flush=True))
+        self.on_stall = on_stall or _default_on_stall
         self._last = time.time()
         self._stop = threading.Event()
         self._thread = None
         self.stalled = False
 
-    def step_done(self):
+    def step_done(self, step=None):
         self._last = time.time()
         self.stalled = False
 
@@ -129,10 +220,12 @@ class StallMonitor:
                     self.on_stall(dt)
         self._thread = threading.Thread(target=watch, daemon=True)
         self._thread.start()
+        _register(self)
         return self
 
     def stop(self):
         self._stop.set()
+        _unregister(self)
 
     def __enter__(self):
         return self.start()
@@ -140,3 +233,178 @@ class StallMonitor:
     def __exit__(self, *exc):
         self.stop()
         return False
+
+
+def _reap(procs, grace_s=5.0, term_first=True):
+    """Terminate a set of child processes WITHOUT ever hanging the
+    supervisor: TERM (optional grace), then KILL on timeout, and keep
+    iterating — one wedged child must not leak its siblings."""
+    import signal as _signal
+    procs = [p for p in procs if p is not None]
+    if term_first:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(_signal.SIGTERM)
+                except OSError:
+                    pass
+    for p in procs:
+        try:
+            p.wait(timeout=grace_s)
+        except subprocess.TimeoutExpired:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            try:
+                p.wait(timeout=grace_s)
+            except subprocess.TimeoutExpired:
+                pass  # unreapable (kernel-stuck); nothing more to do
+        except OSError:
+            pass
+
+
+class Supervisor:
+    """Per-trainer kill+restart supervision (the launcher watch loop
+    grown into detection-driven recovery; distributed/launch.py
+    --elastic builds one).
+
+    `start_fn(rank) -> subprocess.Popen` launches one trainer; the
+    supervisor then watches for three failure shapes:
+
+    - DEATH: the child exited non-zero (SIGKILL'd counts) — restart it;
+    - SILENCE: its heartbeat file is older than heartbeat_timeout_s (or
+      unreadable) — kill + restart;
+    - STALL: the heartbeat keeps beating but its step counter hasn't
+      advanced for stall_timeout_s — flight-record, kill + restart.
+
+    Each restart backs off linearly (backoff_s x restarts) and burns one
+    unit of that rank's PADDLE_ELASTIC_MAX_RESTARTS budget; an exhausted
+    budget tears the whole job down and raises SystemExit with the
+    child's status. Ranks that exit 0 are done. Restarted trainers
+    recover exactly via the verified checkpoint tier
+    (incubate/checkpoint.py) — supervision is only safe because resume
+    is exact."""
+
+    def __init__(self, start_fn, nranks=1, heartbeat_dir=None,
+                 max_restarts=None, backoff_s=None,
+                 heartbeat_timeout_s=None, stall_timeout_s=None,
+                 poll_s=0.2):
+        from ..core.flags import flag as _flag
+        self._start = start_fn
+        self.nranks = int(nranks)
+        self.heartbeat_dir = heartbeat_dir
+        self.max_restarts = int(_flag("PADDLE_ELASTIC_MAX_RESTARTS")
+                                if max_restarts is None else max_restarts)
+        self.backoff_s = float(_flag("PADDLE_ELASTIC_RESTART_BACKOFF_S")
+                               if backoff_s is None else backoff_s)
+        self.heartbeat_timeout_s = float(
+            _flag("PADDLE_ELASTIC_HEARTBEAT_TIMEOUT_S")
+            if heartbeat_timeout_s is None else heartbeat_timeout_s)
+        self.stall_timeout_s = float(
+            _flag("PADDLE_ELASTIC_STALL_TIMEOUT_S")
+            if stall_timeout_s is None else stall_timeout_s)
+        self.poll_s = float(poll_s)
+        self.restarts = {r: 0 for r in range(self.nranks)}
+        self.events: list = []   # (time, rank, reason) timeline
+
+    # -- internals -----------------------------------------------------------
+    def _note(self, rank, reason):
+        from ..core import monitor as _monitor
+        self.events.append((time.time(), rank, reason))
+        _monitor.stat_add("elastic.restarts")
+        print(f"[paddle_tpu.elastic] rank {rank}: {reason}; restart "
+              f"{self.restarts[rank]}/{self.max_restarts}", flush=True)
+
+    def _restart(self, procs, rank, reason, rc=None):
+        from ..core import flight_recorder as _fr
+        self.restarts[rank] += 1
+        if self.restarts[rank] > self.max_restarts:
+            _fr.dump("elastic_budget_exhausted",
+                     extra={"rank": rank, "reason": reason,
+                           "restarts": self.restarts[rank] - 1})
+            _reap(list(procs.values()))
+            raise SystemExit(rc if rc not in (None, 0) else 1)
+        self._note(rank, reason)
+        _fr.dump("elastic_restart", extra={"rank": rank, "reason": reason,
+                                           "restart": self.restarts[rank]})
+        _reap([procs[rank]])
+        time.sleep(self.backoff_s * self.restarts[rank])
+        procs[rank] = self._start(rank)
+
+    def run(self):
+        procs = {rank: self._start(rank) for rank in range(self.nranks)}
+        done: set = set()
+        # per-rank progress tracking for stall detection, plus the
+        # current attempt's start time: a beat file written BEFORE it
+        # belongs to a previous incarnation (or a previous job in the
+        # same heartbeat dir) and proves neither liveness nor death —
+        # without this, one silence restart storms (the stale file
+        # outlives the kill, so every poll of the restarting child
+        # burns another restart until the budget fails the job)
+        last_step = {r: None for r in range(self.nranks)}
+        step_time = {r: time.time() for r in range(self.nranks)}
+        started = {r: time.time() for r in range(self.nranks)}
+
+        def _reset(rank):
+            started[rank] = step_time[rank] = time.time()
+            last_step[rank] = None
+
+        try:
+            while len(done) < self.nranks:
+                beats = (Heartbeat.read(self.heartbeat_dir)
+                         if self.heartbeat_dir else {})
+                now = time.time()
+                for rank in range(self.nranks):
+                    if rank in done:
+                        continue
+                    p = procs[rank]
+                    rc = p.poll()
+                    if rc == 0:
+                        done.add(rank)
+                        continue
+                    if rc is not None:
+                        self._restart(procs, rank, f"exited rc={rc}",
+                                      rc=rc)
+                        _reset(rank)
+                        continue
+                    if not self.heartbeat_dir:
+                        continue
+                    rec = beats.get(rank)
+                    if rec is not None and rec["time"] < started[rank]:
+                        rec = None   # a previous incarnation's beat
+                    if rec is None:
+                        # no beat from THIS attempt yet: grant the
+                        # startup window before declaring silence
+                        if now - started[rank] > self.heartbeat_timeout_s:
+                            self._restart(procs, rank,
+                                          "heartbeat missing/unreadable")
+                            _reset(rank)
+                        continue
+                    if now - rec["time"] > self.heartbeat_timeout_s:
+                        self._restart(procs, rank,
+                                      f"heartbeat stale "
+                                      f"({now - rec['time']:.1f}s)")
+                        _reset(rank)
+                        continue
+                    if rec["step"] != last_step[rank]:
+                        last_step[rank] = rec["step"]
+                        step_time[rank] = now
+                    elif now - step_time[rank] > self.stall_timeout_s:
+                        from ..core import monitor as _monitor
+                        _monitor.stat_add("elastic.stalls")
+                        self._restart(
+                            procs, rank,
+                            f"stalled at step {rec['step']} for "
+                            f"{now - step_time[rank]:.1f}s")
+                        _reset(rank)
+                time.sleep(self.poll_s)
+        except KeyboardInterrupt:
+            _reap(list(procs.values()))
+            raise
+        except SystemExit:
+            raise
+        except BaseException:
+            _reap(list(procs.values()))
+            raise
+        return 0

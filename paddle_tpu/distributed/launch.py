@@ -9,12 +9,13 @@ single-host launches collapse to exec'ing the script with rank 0 env.
 from __future__ import annotations
 
 import os
-import signal
 import subprocess
 import sys
 import time
 
-__all__ = ["launch", "main"]
+from .elastic import Supervisor, _reap
+
+__all__ = ["launch", "launch_elastic", "launch_ps", "main"]
 
 
 def _build_env(rank, nranks, endpoints):
@@ -60,16 +61,17 @@ def launch(script, script_args=(), nproc_per_node=1, host="127.0.0.1",
                         continue
                     procs.remove(p)
                     if ret != 0:
-                        for q in procs:
-                            q.send_signal(signal.SIGTERM)
-                        for q in procs:
-                            q.wait()
+                        # teardown must not hang on (or leak) a wedged
+                        # sibling: TERM, bounded wait, escalate to KILL
+                        _reap(procs)
                         procs.clear()
                         failed_ret = ret
+                        # the snapshot is stale now — every sibling was
+                        # just reaped; iterating on would re-remove them
+                        break
                 time.sleep(0.5)
         except KeyboardInterrupt:
-            for p in procs:
-                p.send_signal(signal.SIGTERM)
+            _reap(procs)
             raise
         if failed_ret is None:
             return 0
@@ -78,6 +80,39 @@ def launch(script, script_args=(), nproc_per_node=1, host="127.0.0.1",
             raise SystemExit(failed_ret)
         print(f"[paddle_tpu.launch] job failed (rc={failed_ret}); elastic "
               f"restart {attempt}/{elastic_retries}", flush=True)
+
+
+def launch_elastic(script, script_args=(), nproc_per_node=1,
+                   host="127.0.0.1", start_port=6170, heartbeat_dir=None,
+                   max_restarts=None, stall_timeout_s=None,
+                   heartbeat_timeout_s=None, backoff_s=None):
+    """Detection-driven elastic launch (`--elastic`): instead of the
+    blind whole-job restart of `launch(elastic_retries=...)`, a
+    `Supervisor` (distributed/elastic.py) watches each trainer's exit
+    status AND its heartbeat file, and kills+restarts INDIVIDUAL
+    trainers on death, heartbeat silence, or stalled step progress —
+    with linear backoff and a PADDLE_ELASTIC_MAX_RESTARTS budget per
+    rank. Trainers see the heartbeat directory as
+    $PADDLE_ELASTIC_HEARTBEAT_DIR and should run a
+    `Heartbeat(dir, step_fn=...)` + auto-checkpoint; restart recovery is
+    exact via the verified checkpoint tier."""
+    endpoints = [f"{host}:{start_port + i}" for i in range(nproc_per_node)]
+    heartbeat_dir = heartbeat_dir or os.environ.get(
+        "PADDLE_ELASTIC_HEARTBEAT_DIR")
+
+    def start_rank(rank):
+        env = _build_env(rank, nproc_per_node, endpoints)
+        if heartbeat_dir:
+            env["PADDLE_ELASTIC_HEARTBEAT_DIR"] = heartbeat_dir
+        return subprocess.Popen([sys.executable, script, *script_args],
+                                env=env)
+
+    return Supervisor(start_rank, nranks=nproc_per_node,
+                      heartbeat_dir=heartbeat_dir,
+                      max_restarts=max_restarts,
+                      stall_timeout_s=stall_timeout_s,
+                      heartbeat_timeout_s=heartbeat_timeout_s,
+                      backoff_s=backoff_s).run()
 
 
 def launch_ps(script, script_args=(), server_num=1, worker_num=2,
@@ -134,14 +169,10 @@ def launch_ps(script, script_args=(), server_num=1, worker_num=2,
                         failed_ret = ret
                 time.sleep(0.3)
         finally:
-            for p in live + servers:
-                if p.poll() is None:
-                    p.send_signal(signal.SIGTERM)
-            for p in live + servers:
-                try:
-                    p.wait(timeout=30)
-                except subprocess.TimeoutExpired:
-                    p.kill()
+            # bounded reap with KILL escalation for EVERY child: a hung
+            # server must neither raise TimeoutExpired through this
+            # teardown nor leak the rest of the fleet
+            _reap(live + servers, grace_s=30.0)
         if failed_ret is None:
             return 0
         attempt += 1
@@ -157,6 +188,14 @@ def main():
     ap.add_argument("--nproc_per_node", type=int, default=1)
     ap.add_argument("--started_port", type=int, default=6170)
     ap.add_argument("--elastic_retries", type=int, default=0)
+    ap.add_argument("--elastic", action="store_true",
+                    help="supervisor-driven per-trainer restart "
+                         "(heartbeat/stall detection, "
+                         "PADDLE_ELASTIC_* knobs) instead of the "
+                         "whole-job elastic_retries loop")
+    ap.add_argument("--heartbeat_dir", default=None,
+                    help="heartbeat directory for --elastic "
+                         "(default $PADDLE_ELASTIC_HEARTBEAT_DIR)")
     ap.add_argument("--server_num", type=int, default=0)
     ap.add_argument("--worker_num", type=int, default=0)
     ap.add_argument("script")
@@ -168,6 +207,11 @@ def main():
                          worker_num=max(args.worker_num, 1),
                          start_port=args.started_port,
                          elastic_retries=args.elastic_retries)
+    if args.elastic:
+        return launch_elastic(args.script, args.script_args,
+                              args.nproc_per_node,
+                              start_port=args.started_port,
+                              heartbeat_dir=args.heartbeat_dir)
     return launch(args.script, args.script_args, args.nproc_per_node,
                   start_port=args.started_port,
                   elastic_retries=args.elastic_retries)

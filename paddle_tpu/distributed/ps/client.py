@@ -52,7 +52,8 @@ class PSClient:
     # push_* (test doubles with bare push signatures stay valid)
     supports_request_keys = True
 
-    def __init__(self, server_endpoints, shard_map=None, **rpc_opts):
+    def __init__(self, server_endpoints, shard_map=None, client_id=None,
+                 **rpc_opts):
         if isinstance(server_endpoints, str):
             server_endpoints = server_endpoints.split(",")
         self.endpoints = list(server_endpoints)
@@ -76,7 +77,7 @@ class PSClient:
             raise errors[0]
         # client-owned replay-id namespace: stable across failover
         # re-routes of one logical call (connection ids are not)
-        self._client_id = uuid.uuid4().hex
+        self._client_id = client_id or uuid.uuid4().hex
         self._seq = 0
         self._seq_lock = threading.Lock()
         self._map_lock = threading.Lock()
@@ -165,6 +166,24 @@ class PSClient:
             c = self._conns.pop(ep, None)
         if c is not None:
             c.close()
+
+    # ------------------------------------------------- replay identity
+    def replay_state(self):
+        """The (client_id, seq) replay identity, checkpointable: a
+        restarted trainer that restores this and re-sends its
+        in-doubt mutations under the SAME keys dedupes server-side
+        across process death — exactly-once survives SIGKILL, not just
+        lost responses (docs/fault_tolerance.md "Trainer recovery")."""
+        with self._seq_lock:
+            return {"client_id": self._client_id, "seq": int(self._seq)}
+
+    def load_replay_state(self, state):
+        cid = state["client_id"]
+        if isinstance(cid, (bytes, np.ndarray)):
+            cid = np.asarray(cid, np.uint8).tobytes().decode("ascii")
+        with self._seq_lock:
+            self._client_id = str(cid)
+            self._seq = int(state.get("seq", 0))
 
     def _next_rid(self, key=None):
         if key is not None:

@@ -810,18 +810,56 @@ class Model:
                                 save_freq=save_freq, save_dir=save_dir,
                                 verbose=verbose,
                                 metrics=self._metrics_name())
-        acp, start_epoch, skip_steps = None, 0, 0
+        def _loader_state():
+            if hasattr(train_loader, "state_dict"):
+                try:
+                    return train_loader.state_dict()
+                except Exception:
+                    return None
+            return None
+
+        acp, start_epoch, skip_steps, step_offset = None, 0, 0, 0
         if auto_checkpoint_dir is not None:
             from ..incubate.checkpoint import TrainingCheckpoint
             acp = TrainingCheckpoint(auto_checkpoint_dir,
                                      keep=keep_checkpoint_max,
                                      save_interval_steps=auto_checkpoint_freq)
-            counters = acp.restore_into(self)
+            resumable = train_loader if hasattr(
+                train_loader, "load_state_dict") else None
+            counters = acp.restore_into(self, data_loader=resumable)
             if counters is not None:
                 self._global_step = counters["global_step"]
                 start_epoch = counters["epoch"]
                 skip_steps = counters["step"] + 1
-                if steps is not None and skip_steps >= steps:
+                if counters.get("data_resumed"):
+                    # the loader fast-forwards itself (sampler-level
+                    # skip, exact shuffle state) — fit only offsets the
+                    # step numbering instead of replaying batches
+                    step_offset, skip_steps = skip_steps, 0
+                    # a cursor at the epoch boundary — the natural end
+                    # OR fit's steps= cap (a boundary the loader can't
+                    # see) — means that epoch is DONE: roll fit's epoch
+                    # label in step with the loader's auto-roll, else
+                    # the resumed loop trains one extra loader epoch
+                    # under a stale label
+                    bounds = [steps]
+                    try:
+                        bounds.append(len(train_loader))
+                    except TypeError:
+                        pass
+                    epoch_len = min(b for b in bounds if b is not None) \
+                        if any(b is not None for b in bounds) else None
+                    if epoch_len is not None and step_offset >= epoch_len:
+                        start_epoch, step_offset = start_epoch + 1, 0
+                        # steps= truncation: advance the loader past the
+                        # truncated epoch's permutation so the next
+                        # iteration starts the new epoch fresh instead
+                        # of replaying the truncated epoch's tail (a
+                        # natural epoch end auto-rolls; this is a no-op
+                        # there)
+                        if hasattr(resumable, "roll_resumed_epoch"):
+                            resumable.roll_resumed_epoch()
+                elif steps is not None and skip_steps >= steps:
                     start_epoch, skip_steps = start_epoch + 1, 0
             else:
                 self._global_step = 0
@@ -830,11 +868,22 @@ class Model:
         guard = contextlib.nullcontext()
         if acp is not None:
             from ..incubate.checkpoint import PreemptionGuard
-            self._acp_pos = (start_epoch, max(skip_steps - 1, 0))
+            self._acp_pos = (start_epoch,
+                             max(skip_steps + step_offset - 1, 0))
+            # the guard capture uses the data state snapshotted at the
+            # last COMPLETED batch (kept in step with _acp_pos by
+            # _run_one_epoch), never the live loader cursor: a SIGTERM
+            # mid-batch would otherwise save a cursor one batch ahead
+            # of the applied optimizer state and the resume would skip
+            # that batch
+            self._acp_data_state = _loader_state()
             guard = PreemptionGuard(
                 acp, lambda: (self._global_step,
                               acp.capture(self, *self._acp_pos,
-                                          self._global_step)))
+                                          self._global_step,
+                                          data_state=getattr(
+                                              self, "_acp_data_state",
+                                              None))))
 
         cbks.on_begin("train")
         logs = {}
@@ -846,8 +895,10 @@ class Model:
                                            accum=accumulate_grad_batches,
                                            epoch=epoch,
                                            skip_steps=skip_steps,
+                                           step_offset=step_offset,
                                            log_freq=log_freq)
                 skip_steps = 0
+                step_offset = 0
                 cbks.on_epoch_end(epoch, logs)
                 if do_eval and epoch % eval_freq == 0:
                     eval_logs = self.evaluate(eval_loader, callbacks=cbks,
@@ -909,7 +960,7 @@ class Model:
         return merged
 
     def _run_one_epoch(self, loader, cbks, mode, num_iters=None, accum=1,
-                       epoch=0, skip_steps=0, log_freq=10):
+                       epoch=0, skip_steps=0, step_offset=0, log_freq=10):
         from collections import deque
         from ..core import flags as _flags
         for m in self._metrics:
@@ -938,7 +989,8 @@ class Model:
                               or len(window) > inflight):
                 window.popleft()._materialize()
 
-        for step, batch in enumerate(loader):
+        from ..distributed import elastic as _elastic
+        for step, batch in enumerate(loader, start=step_offset):
             if step < skip_steps:
                 continue  # resumed mid-epoch: fast-forward consumed batches
             cbks.on_batch_begin(mode, step, logs)
@@ -961,12 +1013,24 @@ class Model:
             logs["batch_size"] = np.asarray(inputs[0]).shape[0]
             metric_logs = self._update_metrics(outs, labels)
             logs.update(metric_logs)
+            if mode == "train":
+                _elastic.notify_step()  # StallMonitor/Heartbeat pulse
             if acp is not None and mode == "train":
                 # account the completed batch BEFORE callbacks: a SIGTERM
                 # raised from a callback must capture this step as done
                 self._global_step = getattr(self, "_global_step", 0) + 1
                 self._acp_pos = (epoch, step)
-                acp.maybe_save(self, epoch, step, self._global_step)
+                data_state = None
+                if hasattr(loader, "state_dict"):
+                    try:
+                        data_state = loader.state_dict()
+                    except Exception:
+                        data_state = None
+                # batch-end snapshot for the PreemptionGuard capture:
+                # consistent with _acp_pos/_global_step by construction
+                self._acp_data_state = data_state
+                acp.maybe_save(self, epoch, step, self._global_step,
+                               data_state=data_state)
             cbks.on_batch_end(mode, step, logs)
             if num_iters is not None and step + 1 >= num_iters:
                 break

@@ -1,4 +1,5 @@
-"""Preemption-safe training checkpoints (orbax-backed).
+"""Preemption-safe training checkpoints (orbax-backed), with a
+verification tier that makes trainer death a non-event.
 
 Analog of the reference auto-checkpoint stack:
 - fluid/incubate/checkpoint/auto_checkpoint.py:71 (`AutoCheckpointChecker`,
@@ -13,17 +14,49 @@ Analog of the reference auto-checkpoint stack:
 
 State captured per step: parameters+buffers, full optimizer state (slots,
 step count, LR schedule), AMP loss-scaler state, the ambient PRNG chain
-head, and (epoch, step, global_step) counters — everything needed for a
-bit-identical training continuation after SIGKILL.
+head, the data-pipeline position (epoch, next-batch cursor, shuffle RNG
+state — DataLoader.state_dict), and (epoch, step, global_step) counters —
+everything needed for a bit-identical training continuation after SIGKILL.
+
+Integrity tier (docs/fault_tolerance.md "Trainer recovery"): every save
+writes a sidecar manifest — per-leaf sha256 over the exact bytes handed
+to orbax plus a tree schema of shapes/dtypes — committed atomically next
+to orbax's own atomic step-directory rename. Restore re-hashes what it
+read; a corrupt, partial, or schema-mismatched step raises a structured
+`CheckpointCorruptError` naming the first bad leaf, and the default
+latest-restore QUARANTINES the bad step (`.quarantine/` + the
+`ckpt.corrupt_skipped` counter + a flight-recorder note) and walks back
+to the newest checkpoint that verifies — a torn write costs one
+checkpoint interval, never the job.
 """
 from __future__ import annotations
 
+import hashlib
+import json
 import os
+import shutil
+import time
 from typing import Optional
 
 import numpy as np
 
-__all__ = ["TrainingCheckpoint", "train_epoch_range", "PreemptionGuard"]
+__all__ = ["TrainingCheckpoint", "train_epoch_range", "PreemptionGuard",
+           "CheckpointCorruptError"]
+
+MANIFEST_VERSION = 1
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed verification. `step` is the checkpoint step,
+    `leaf` the first offending tree path ("<unreadable>" when the store
+    itself could not be read), `reason` what mismatched."""
+
+    def __init__(self, step, leaf, reason):
+        self.step = int(step)
+        self.leaf = leaf
+        self.reason = reason
+        super().__init__(
+            f"checkpoint step {step} is corrupt at leaf {leaf!r}: {reason}")
 
 
 def _np_tree(obj):
@@ -40,8 +73,67 @@ def _np_tree(obj):
     return obj
 
 
+def _flat_leaves(tree, prefix=""):
+    """Deterministic (path, leaf) walk: dicts by sorted key, lists by
+    index — the manifest's leaf namespace."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _flat_leaves(tree[k], f"{prefix}/{k}" if prefix
+                                    else str(k))
+        return
+    if isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _flat_leaves(v, f"{prefix}/{i}" if prefix
+                                    else str(i))
+        return
+    yield prefix, tree
+
+
+def _leaf_record(leaf):
+    """(shape, dtype, sha256) of one leaf, over the canonical numpy
+    form — symmetric between save time and restore time, so a bit flip
+    anywhere in the stored bytes surfaces as a hash mismatch."""
+    arr = np.asarray(leaf)
+    return {"shape": list(arr.shape), "dtype": str(arr.dtype),
+            "sha256": hashlib.sha256(
+                np.ascontiguousarray(arr).tobytes()).hexdigest()}
+
+
+def build_manifest(step, state):
+    return {"manifest_version": MANIFEST_VERSION, "step": int(step),
+            "time": time.time(),
+            "leaves": {path: _leaf_record(leaf)
+                       for path, leaf in _flat_leaves(state)}}
+
+
+def verify_manifest(step, state, manifest):
+    """Raise CheckpointCorruptError naming the first bad leaf if `state`
+    does not match `manifest` (missing/extra leaves, shape/dtype drift,
+    hash mismatch)."""
+    want = manifest.get("leaves", {})
+    got = {path: leaf for path, leaf in _flat_leaves(state)}
+    for path in sorted(want):
+        if path not in got:
+            raise CheckpointCorruptError(step, path,
+                                         "leaf missing from restored tree")
+    for path in sorted(got):
+        if path not in want:
+            raise CheckpointCorruptError(step, path,
+                                         "leaf absent from manifest")
+        rec = _leaf_record(got[path])
+        ref = want[path]
+        for field in ("shape", "dtype"):
+            if rec[field] != ref[field]:
+                raise CheckpointCorruptError(
+                    step, path, f"{field} mismatch: manifest "
+                    f"{ref[field]!r}, restored {rec[field]!r}")
+        if rec["sha256"] != ref["sha256"]:
+            raise CheckpointCorruptError(step, path, "sha256 mismatch")
+
+
 class TrainingCheckpoint:
-    """Async step-atomic training checkpoints with keep-latest-k."""
+    """Async step-atomic training checkpoints with keep-latest-k and
+    manifest verification."""
 
     def __init__(self, directory, keep=3, save_interval_steps=50,
                  async_save=True):
@@ -55,33 +147,195 @@ class TrainingCheckpoint:
                 max_to_keep=keep,
                 enable_async_checkpointing=async_save))
         self.save_interval_steps = int(save_interval_steps)
+        self._emergency_handle = None
+        self._emergency_fired = False
+        self._in_save = False   # re-entrancy guard for signal-time saves
+
+    # -- manifest plumbing ---------------------------------------------------
+    def _manifest_path(self, step):
+        return os.path.join(self.directory, f"manifest_{int(step)}.json")
+
+    def _write_manifest(self, step, state):
+        path = self._manifest_path(step)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(build_manifest(step, state), f)
+        os.replace(tmp, path)
+
+    def _read_manifest(self, step):
+        try:
+            with open(self._manifest_path(step)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _gc_manifests(self, protect=()):
+        """Drop manifests whose step orbax already retired (keep-latest-k)
+        or that was quarantined; best-effort. `protect` shields steps
+        whose async commit may still be in flight."""
+        try:
+            live = set(int(s) for s in self._mngr.all_steps())
+        except Exception:
+            return
+        live |= {int(s) for s in protect}
+        try:
+            for name in os.listdir(self.directory):
+                if not (name.startswith("manifest_")
+                        and name.endswith(".json")):
+                    continue
+                try:
+                    step = int(name[len("manifest_"):-len(".json")])
+                except ValueError:
+                    continue
+                if step not in live:
+                    os.unlink(os.path.join(self.directory, name))
+        except OSError:
+            pass
+
+    def _quarantine(self, step, exc):
+        """Move a corrupt step out of the manager's sight so the restore
+        walk-back (and every later restart) lands on a verified step, and
+        leave the evidence on disk for post-mortem."""
+        from ..core import flight_recorder as _fr
+        from ..core import monitor as _monitor
+        qdir = os.path.join(self.directory, ".quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        src = os.path.join(self.directory, str(int(step)))
+        dst = os.path.join(qdir, f"{int(step)}_{int(time.time())}")
+        try:
+            if os.path.isdir(src):
+                os.replace(src, dst)
+            mpath = self._manifest_path(step)
+            if os.path.exists(mpath):
+                shutil.move(mpath, dst + ".manifest.json")
+        except OSError:
+            pass
+        _monitor.stat_add("ckpt.corrupt_skipped")
+        _fr.dump("ckpt_corrupt", exc,
+                 extra={"step": int(step), "directory": self.directory,
+                        "leaf": getattr(exc, "leaf", None),
+                        "quarantined_to": dst})
+        if hasattr(self._mngr, "reload"):
+            try:  # forget the cached step list
+                self._mngr.reload()
+            except Exception:
+                pass
 
     # -- low-level ----------------------------------------------------------
     def save(self, step: int, state: dict, force=False):
-        self._mngr.save(int(step), args=self._ocp.args.StandardSave(
-            _np_tree(state)), force=force)
+        state = _np_tree(state)
+        # manifest first: it hashes the exact tree handed to orbax. The
+        # COMMIT marker stays orbax's atomic step-dir rename — a SIGKILL
+        # between the two leaves a manifest without a step (harmless,
+        # GC'd) never a committed step whose manifest lies.
+        self._in_save = True
+        try:
+            self._write_manifest(step, state)
+            self._mngr.save(int(step),
+                            args=self._ocp.args.StandardSave(state),
+                            force=force)
+            self._gc_manifests(protect=(int(step),))
+        finally:
+            self._in_save = False
+
+    def emergency_save(self, step: int, state: dict):
+        """Synchronous forced save for failure paths (SIGTERM grace,
+        PipelineStepError): returns only once the step is durable."""
+        self.save(int(step), state, force=True)
+        self.wait()
+
+    def install_emergency_save(self, capture_fn,
+                               reasons=("pipeline_step_error",
+                                        "signal_SIGTERM")):
+        """Join the flight-recorder trigger points: when a dump fires for
+        one of `reasons`, run one synchronous emergency save of
+        capture_fn() -> (step, state). Fires at most once per process —
+        a failure storm must not re-enter the save path."""
+        from ..core import flight_recorder as _fr
+
+        def hook(reason, exc):
+            # _in_save: the signal landed INSIDE a checkpoint save on
+            # this very manager (hooks run on the interrupted main
+            # thread) — re-entering orbax mid-mutation could deadlock
+            # past the eviction deadline or tear the step being
+            # written; die on the last committed step instead
+            if self._emergency_fired or self._in_save:
+                return
+            self._emergency_fired = True
+            step, state = capture_fn()
+            self.emergency_save(step, state)
+
+        self._emergency_handle = _fr.register_emergency_hook(hook, reasons)
+        return self._emergency_handle
+
+    def uninstall_emergency_save(self):
+        if self._emergency_handle is not None:
+            from ..core import flight_recorder as _fr
+            _fr.unregister_emergency_hook(self._emergency_handle)
+            self._emergency_handle = None
 
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 
-    def restore(self, step: Optional[int] = None) -> Optional[dict]:
-        step = self.latest_step() if step is None else step
-        if step is None:
-            return None
+    def all_steps(self):
+        return sorted(int(s) for s in self._mngr.all_steps())
+
+    def _restore_verified(self, step):
+        """Load one step and verify it against its manifest. Raises
+        CheckpointCorruptError (corrupt/mismatched), FileNotFoundError
+        (no such step)."""
+        from ..core import flags as _flags
+        from ..core import monitor as _monitor
         try:
-            return self._mngr.restore(
-                step, args=self._ocp.args.StandardRestore())
+            state = self._mngr.restore(
+                int(step), args=self._ocp.args.StandardRestore())
         except FileNotFoundError:
-            return None  # e.g. a step already GC'd by keep-latest-k
+            raise
+        except Exception as e:
+            # torn/partial step directory: orbax could not even read it
+            raise CheckpointCorruptError(step, "<unreadable>",
+                                         f"{type(e).__name__}: {e}")
+        manifest = self._read_manifest(step)
+        if manifest is None:
+            # pre-manifest (legacy) checkpoint: loadable, not provable
+            _monitor.stat_add("ckpt.unverified_loads")
+            return state
+        if _flags.flag("PADDLE_CKPT_VERIFY"):
+            verify_manifest(step, state, manifest)
+            _monitor.stat_set("ckpt.last_verified_step", int(step))
+        return state
+
+    def restore(self, step: Optional[int] = None) -> Optional[dict]:
+        """Restore a verified checkpoint. With an explicit `step`:
+        returns None if the step is gone (GC'd), raises
+        CheckpointCorruptError if it exists but fails verification.
+        With step=None: walks newest -> oldest, quarantining every
+        corrupt step, and returns the newest state that verifies (None
+        when nothing restorable exists)."""
+        if step is not None:
+            try:
+                return self._restore_verified(step)
+            except FileNotFoundError:
+                return None  # e.g. a step already GC'd by keep-latest-k
+        for s in sorted(self.all_steps(), reverse=True):
+            try:
+                return self._restore_verified(s)
+            except CheckpointCorruptError as e:
+                self._quarantine(s, e)
+            except FileNotFoundError:
+                continue
+        return None
 
     def wait(self):
         self._mngr.wait_until_finished()
 
     def close(self):
+        self.uninstall_emergency_save()
         self._mngr.close()
 
     # -- Model.fit integration ---------------------------------------------
-    def capture(self, model, epoch, step, global_step) -> dict:
+    def capture(self, model, epoch, step, global_step,
+                data_state=None, ps_state=None) -> dict:
         from ..core import rng as _rng
         state = {
             "model": {k: v for k, v in _np_tree(
@@ -95,25 +349,52 @@ class TrainingCheckpoint:
         scaler = amp_cfg.get("scaler") if amp_cfg else None
         if scaler is not None:
             state["scaler"] = _np_tree(scaler.scale_state())
+        if data_state is not None:
+            state["data"] = _np_tree(data_state)
+        if ps_state is not None:
+            state["ps"] = _np_tree(ps_state)
         return state
 
-    def maybe_save(self, model, epoch, step, global_step, force=False):
+    def maybe_save(self, model, epoch, step, global_step, force=False,
+                   data_state=None, ps_state=None):
         if force or (global_step % self.save_interval_steps == 0
                      and global_step > 0):
             self.save(global_step,
-                      self.capture(model, epoch, step, global_step),
+                      self.capture(model, epoch, step, global_step,
+                                   data_state=data_state,
+                                   ps_state=ps_state),
                       force=force)
             return True
         return False
 
-    def restore_into(self, model) -> Optional[dict]:
-        """Restore the latest checkpoint into model/optimizer/rng; returns
-        the counters dict (or None if no checkpoint exists)."""
+    def restore_into(self, model, data_loader=None) -> Optional[dict]:
+        """Restore the latest verified checkpoint into
+        model/optimizer/rng (and, when `data_loader` supports
+        load_state_dict and the checkpoint carries a `data` section, the
+        data-pipeline position); returns the counters dict (or None if
+        no checkpoint exists). Parameter-shape drift between the
+        checkpoint and the live model raises a per-param ValueError
+        instead of a broadcast crash deep in set_state_dict."""
         state = self.restore()
         if state is None:
             return None
         from ..core import rng as _rng
         import jax.numpy as jnp
+        live = dict(model.network.state_dict())
+        for name, saved in state["model"].items():
+            cur = live.get(name)
+            if cur is None:
+                continue  # set_state_dict owns unknown-key policy
+            saved_shape = tuple(np.asarray(saved).shape)
+            cur_shape = tuple(np.asarray(
+                cur._value if hasattr(cur, "_value") else cur).shape)
+            if saved_shape != cur_shape:
+                raise ValueError(
+                    f"checkpoint/model shape mismatch for parameter "
+                    f"{name!r}: checkpoint has {list(saved_shape)}, model "
+                    f"has {list(cur_shape)} — the model architecture "
+                    "changed since this checkpoint was written; restore "
+                    "it into the original architecture or start fresh")
         model.network.set_state_dict(state["model"])
         model._optimizer.set_state_dict(state["optimizer"])
         if "scaler" in state:
@@ -124,7 +405,15 @@ class TrainingCheckpoint:
         key = state["rng_key"]
         _rng.default_generator().seat(jnp.asarray(
             np.asarray(key, dtype=np.uint32)))
-        return dict(state["counters"])
+        counters = dict(state["counters"])
+        counters = {k: int(v) for k, v in counters.items()}
+        if data_loader is not None and "data" in state \
+                and hasattr(data_loader, "load_state_dict"):
+            data_loader.load_state_dict(state["data"])
+            counters["data_resumed"] = True
+        if "ps" in state:
+            counters["ps_state"] = state["ps"]
+        return counters
 
 
 class PreemptionGuard:
@@ -133,14 +422,34 @@ class PreemptionGuard:
     watch loop + auto-checkpoint). While installed, SIGTERM triggers one
     forced synchronous checkpoint before the default handler runs, so a
     preempted job resumes from its exact step instead of the last
-    periodic save."""
+    periodic save. With `runner` (a PipelineRunner), the capture is
+    preceded by `runner.sync()` — in-flight steps drain and the
+    device-resident carry writes back, so the saved step count matches
+    the applied optimizer state with nothing lost or double-run."""
 
-    def __init__(self, ckpt: TrainingCheckpoint, capture_fn):
+    def __init__(self, ckpt: TrainingCheckpoint, capture_fn, runner=None):
         """capture_fn() -> (step, state_dict) captured at signal time."""
         self._ckpt = ckpt
         self._capture = capture_fn
+        self._runner = runner
         self._prev = None
         self.fired = False
+
+    def _grace_save(self):
+        if getattr(self._ckpt, "_in_save", False):
+            # SIGTERM landed inside a periodic save on this manager
+            # (the handler runs on the interrupted main thread):
+            # re-entering orbax could deadlock past the eviction
+            # deadline — recovery falls back to the last committed step
+            return
+        if self._runner is not None:
+            try:
+                self._runner.sync()
+            except Exception:
+                pass  # a poisoned pipeline: save what the carry left
+        step, state = self._capture()
+        self._ckpt.save(step, state, force=True)
+        self._ckpt.wait()
 
     def __enter__(self):
         import signal
@@ -148,9 +457,7 @@ class PreemptionGuard:
         def handler(signum, frame):
             self.fired = True
             try:
-                step, state = self._capture()
-                self._ckpt.save(step, state, force=True)
-                self._ckpt.wait()
+                self._grace_save()
             finally:
                 if callable(self._prev):
                     self._prev(signum, frame)
@@ -176,7 +483,12 @@ def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,
     """reference auto_checkpoint.py `train_epoch_range`: a resumable epoch
     iterator. The epoch counter persists under `directory` (or
     $PADDLE_TPU_CHECKPOINT_DIR / ./paddle_tpu_auto_checkpoint); on restart
-    iteration continues from the last completed epoch."""
+    iteration continues from the last completed epoch. An epoch COMMITS
+    only when the loop body finishes AND the iterator is resumed — a
+    trainer killed between the yield and the post-epoch save redoes that
+    epoch rather than skipping it (exactly-once would need the body's
+    side effects to be transactional; redo keeps the at-least-once
+    contract the reference chose)."""
     directory = directory or os.environ.get(
         "PADDLE_TPU_CHECKPOINT_DIR", "./paddle_tpu_auto_checkpoint")
     ckpt = TrainingCheckpoint(directory, keep=2, async_save=False)

@@ -78,7 +78,8 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 shuffle_seed=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -87,12 +88,26 @@ class DataLoader:
         self.use_shared_memory = use_shared_memory
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
+        # exact-resume position: epoch count, next-batch cursor, pending
+        # load_state_dict payload (docs/fault_tolerance.md "Trainer
+        # recovery")
+        self._epoch = 0
+        self._pos_batch = 0
+        self._resume = None
         if self._iterable_mode:
             self.batch_sampler = None
             self.batch_size = batch_size
             self.drop_last = drop_last
         elif batch_sampler is not None:
             self.batch_sampler = batch_sampler
+        elif shuffle and shuffle_seed is not None:
+            # a PRIVATE seeded shuffle stream: every epoch's permutation
+            # is derivable from the checkpointed rng state alone, so a
+            # restarted trainer replays the exact batch schedule
+            from .sampler import RandomSampler
+            self.batch_sampler = BatchSampler(
+                sampler=RandomSampler(dataset, generator=shuffle_seed),
+                batch_size=batch_size, drop_last=drop_last)
         else:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
                                               batch_size=batch_size,
@@ -103,7 +118,78 @@ class DataLoader:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
 
-    def _batches(self):
+    # -- exact mid-epoch resume ---------------------------------------------
+    def state_dict(self):
+        """Data-pipeline position for the checkpoint's `data` section:
+        epoch, next-batch cursor, and the sampler's shuffle-rng state.
+        None for IterableDataset loaders (no index space to cursor)."""
+        if self._iterable_mode:
+            return None
+        if self._resume is not None:
+            # armed-but-unconsumed resume: the pending position IS the
+            # current position (a grace save taken before the first
+            # resumed batch must re-save the restored cursor, not a
+            # stale local one)
+            return {k: v for k, v in self._resume.items()}
+        sd = {"epoch": int(self._epoch), "batch": int(self._pos_batch)}
+        if hasattr(self.batch_sampler, "state_dict"):
+            sd["sampler"] = self.batch_sampler.state_dict()
+        return sd
+
+    def load_state_dict(self, sd):
+        """Arm the NEXT iteration to resume at the saved position: the
+        sampler re-draws the saved epoch's permutation from its
+        checkpointed rng state and the first `batch` index-batches are
+        skipped at the sampler level (no dataset/collate work). A cursor
+        at end-of-epoch advances the shuffle stream past that epoch and
+        falls through to a fresh one."""
+        if sd is None or self._iterable_mode:
+            return
+        self._resume = {k: v for k, v in sd.items()}
+
+    def roll_resumed_epoch(self):
+        """Treat the armed resume position as end-of-epoch. The caller's
+        epoch was truncated at a batch count the loader can't see (hapi
+        fit's steps= cap): the next iteration must draw AND DISCARD that
+        epoch's permutation — advancing the shuffle stream exactly as
+        the uninterrupted run's next epoch would — and start the
+        following epoch fresh, not replay the truncated epoch's tail."""
+        if self._resume is None or self._iterable_mode:
+            return
+        try:
+            self._resume["batch"] = len(self.batch_sampler)
+        except TypeError:
+            self._resume = None   # unsized sampler: start fresh
+
+    def _epoch_indices(self):
+        """The index-batch iterable for this iteration, resume applied."""
+        import itertools
+        skip = 0
+        if self._resume is not None:
+            sd, self._resume = self._resume, None
+            if sd.get("sampler") is not None \
+                    and hasattr(self.batch_sampler, "load_state_dict"):
+                self.batch_sampler.load_state_dict(sd["sampler"])
+            self._epoch = int(sd.get("epoch", 0))
+            skip = int(sd.get("batch", 0))
+            try:
+                total = len(self.batch_sampler)
+            except TypeError:
+                total = None
+            if total is not None and skip >= total:
+                # the saved epoch was complete: draw (and discard) its
+                # permutation so the shuffle stream advances exactly as
+                # the uninterrupted run's would, then start fresh
+                for _ in self.batch_sampler:
+                    pass
+                self._epoch += 1
+                skip = 0
+        it = iter(self.batch_sampler)
+        if skip:
+            it = itertools.islice(it, skip, None)
+        return it, skip
+
+    def _batches(self, index_batches=None):
         if self._iterable_mode:
             buf = []
             for sample in self.dataset:
@@ -114,10 +200,12 @@ class DataLoader:
             if buf and not self.drop_last:
                 yield self.collate_fn(buf)
             return
-        for indices in self.batch_sampler:
+        if index_batches is None:
+            index_batches = iter(self.batch_sampler)
+        for indices in index_batches:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
-    def _batches_threaded(self):
+    def _batches_threaded(self, index_batches):
         """Fetch batches with a worker pool; keep `prefetch_factor` in flight."""
         pool = ThreadPoolExecutor(max_workers=self.num_workers)
         sentinel = object()
@@ -135,7 +223,7 @@ class DataLoader:
 
         def producer():
             try:
-                for indices in self.batch_sampler:
+                for indices in index_batches:
                     try:
                         fut = pool.submit(fetch, indices)
                     except RuntimeError:
@@ -179,12 +267,12 @@ class DataLoader:
             pool.shutdown(wait=True, cancel_futures=True)
             t.join(timeout=5)
 
-    def _batches_multiprocess(self):
+    def _batches_multiprocess(self, index_batches):
         """Forked worker processes; batches re-ordered by index so epoch
         order matches the sampler regardless of worker timing."""
         import multiprocessing as mp
         ctx = mp.get_context("fork")
-        tasks = list(enumerate(self.batch_sampler))
+        tasks = list(enumerate(index_batches))
         index_q = ctx.Queue()
         result_q = ctx.Queue(
             maxsize=max(2, self.prefetch_factor) * self.num_workers)
@@ -220,13 +308,28 @@ class DataLoader:
                 w.join(timeout=5)
 
     def __iter__(self):
-        if self.num_workers > 0 and not self._iterable_mode:
+        if self._iterable_mode:
+            yield from self._iter_stream(self._batches())
+            return
+        index_batches, skip = self._epoch_indices()
+        if self.num_workers > 0:
             if self.use_shared_memory:
-                gen = self._batches_multiprocess()
+                gen = self._batches_multiprocess(index_batches)
             else:
-                gen = self._batches_threaded()
+                gen = self._batches_threaded(index_batches)
         else:
-            gen = self._batches()
+            gen = self._batches(index_batches)
+        # track the consumed-batch cursor so state_dict() taken at any
+        # step names the exact next batch; a full epoch rolls the epoch
+        # counter so multi-epoch resumes re-derive later permutations
+        self._pos_batch = skip
+        for b in self._iter_stream(gen):
+            self._pos_batch += 1
+            yield b
+        self._epoch += 1
+        self._pos_batch = 0
+
+    def _iter_stream(self, gen):
         if not self.use_buffer_reader:
             yield from gen
             return

@@ -116,6 +116,7 @@ class InMemoryDataset(DatasetBase):
         self._splits = None   # per slot: np row_splits
         self._rows = 0
         self._order = None
+        self._pending_order = None   # restored before load_into_memory
 
     def load_into_memory(self):
         types_n = len(self._slot_types())
@@ -134,6 +135,10 @@ class InMemoryDataset(DatasetBase):
         self._splits = [np.concatenate(s) for s in splits]
         self._rows = rows
         self._order = np.arange(rows)
+        if self._pending_order is not None:
+            order, self._pending_order = self._pending_order, None
+            self._check_order(order)
+            self._order = order
 
     def get_memory_data_size(self):
         return self._rows
@@ -160,15 +165,51 @@ class InMemoryDataset(DatasetBase):
             perm = perm[jax.process_index()::nproc]
         self._order = perm
 
-    def batches(self, drop_last=True):
+    def batches(self, drop_last=True, start_batch=0):
+        """`start_batch` skips the first N batches at the index level (no
+        parse/pad work) — the exact-resume entry point
+        Executor.train_from_dataset threads its start_batch through."""
         if self._values is None:
             raise RuntimeError("call load_into_memory() first")
         bs = self._batch_size
         n = len(self._order)
         stop = (n // bs) * bs if drop_last else n
-        for lo in range(0, stop, bs):
+        for lo in range(int(start_batch) * bs, stop, bs):
             order = self._order[lo:lo + bs]
             yield self._rows_to_feed(order, self._values, self._splits)
+
+    # -- exact resume --------------------------------------------------------
+    def state_dict(self):
+        """Shuffle position for the checkpoint's `data` section: the
+        seed counter and, when a shuffle has been drawn, the current
+        sample order itself (exact — no re-derivation assumptions)."""
+        sd = {"seed": int(self._seed)}
+        if self._order is not None:
+            sd["order"] = np.asarray(self._order, np.int64)
+        return sd
+
+    def _check_order(self, order):
+        if len(order) != self._rows:
+            raise ValueError(
+                f"dataset state has {len(order)} samples but "
+                f"{self._rows} are loaded — resume state belongs to "
+                "a different filelist")
+
+    def load_state_dict(self, sd):
+        self._seed = int(sd.get("seed", 0))
+        order = sd.get("order")
+        if order is None:
+            return
+        order = np.asarray(order, np.int64)
+        if not self._rows:
+            # restored before load_into_memory: DEFER the order (applied
+            # when rows load) rather than silently dropping it — a
+            # later shuffle from seed+1 would walk a different
+            # permutation than the killed run
+            self._pending_order = order
+            return
+        self._check_order(order)
+        self._order = order
 
 
 class QueueDataset(DatasetBase):

@@ -1,11 +1,32 @@
 """Samplers (reference python/paddle/fluid/dataloader/sampler.py and
 batch_sampler.py; DistributedBatchSampler from distributed training path).
+
+Exact-resume support (ISSUE 8): shuffling samplers snapshot their RNG
+state at the START of each epoch's draw, and `state_dict()` /
+`load_state_dict()` round-trip it — a restarted trainer re-draws the SAME
+permutation the killed one was walking, so a mid-epoch resume replays
+identical batches (the checkpoint's `data` section; see
+incubate/checkpoint.py and docs/fault_tolerance.md "Trainer recovery").
 """
 from __future__ import annotations
 
 import math
 
 import numpy as np
+
+
+def _rng_state_dict(state):
+    """np.random RandomState tuple -> checkpointable {key, pos} (arrays
+    and ints only: orbax-serializable, hash-stable)."""
+    if state is None:
+        return None
+    _, key, pos, _, _ = state
+    return {"key": np.asarray(key, np.uint32), "pos": int(pos)}
+
+
+def _rng_state_tuple(sd):
+    return ("MT19937", np.asarray(sd["key"], np.uint32), int(sd["pos"]),
+            0, 0.0)
 
 __all__ = ["Sampler", "SequenceSampler", "RandomSampler",
            "WeightedRandomSampler", "BatchSampler",
@@ -29,24 +50,59 @@ class SequenceSampler(Sampler):
 
 
 class RandomSampler(Sampler):
+    """`generator` may be an int seed or a np.random.RandomState: the
+    sampler then owns a PRIVATE stream (required for exact mid-epoch
+    resume — the global np.random stream is consumed by model init and
+    cannot be replayed). Default None keeps the legacy global-stream
+    draw; resume support still snapshots the state it drew from."""
+
     def __init__(self, data_source, replacement=False, num_samples=None,
                  generator=None):
         super().__init__(data_source)
         self.replacement = replacement
         self._num_samples = num_samples
+        if isinstance(generator, (int, np.integer)):
+            generator = np.random.RandomState(int(generator))
+        self._rng = generator
+        self._pending_state = None   # installed by load_state_dict
+        self._epoch_state = None     # state the CURRENT epoch drew from
 
     @property
     def num_samples(self):
         return self._num_samples or len(self.data_source)
 
+    def _draw_rng(self):
+        """The stream this epoch draws from, with its start-state
+        snapshotted (and a pending resume state installed first)."""
+        rng = self._rng if self._rng is not None else np.random
+        if self._pending_state is not None:
+            if self._rng is None:
+                # resuming a global-stream sampler: replay through a
+                # private stream so the global chain is left alone
+                self._rng = rng = np.random.RandomState()
+            rng.set_state(_rng_state_tuple(self._pending_state))
+            self._pending_state = None
+        self._epoch_state = _rng_state_dict(rng.get_state())
+        return rng
+
     def __iter__(self):
         n = len(self.data_source)
+        rng = self._draw_rng()
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[: self.num_samples].tolist())
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[: self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples
+
+    # -- exact resume --------------------------------------------------------
+    def state_dict(self):
+        return {} if self._epoch_state is None \
+            else {"rng": self._epoch_state}
+
+    def load_state_dict(self, sd):
+        if sd and sd.get("rng") is not None:
+            self._pending_state = sd["rng"]
 
 
 class WeightedRandomSampler(Sampler):
@@ -90,6 +146,16 @@ class BatchSampler(Sampler):
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
+
+    # -- exact resume (delegates to the index sampler) -----------------------
+    def state_dict(self):
+        if hasattr(self.sampler, "state_dict"):
+            return {"sampler": self.sampler.state_dict()}
+        return {}
+
+    def load_state_dict(self, sd):
+        if sd.get("sampler") and hasattr(self.sampler, "load_state_dict"):
+            self.sampler.load_state_dict(sd["sampler"])
 
 
 class DistributedBatchSampler(BatchSampler):
@@ -137,3 +203,11 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+    # -- exact resume: the epoch IS the rng seed here ------------------------
+    def state_dict(self):
+        return {"epoch": int(self.epoch)}
+
+    def load_state_dict(self, sd):
+        if "epoch" in sd:
+            self.epoch = int(sd["epoch"])

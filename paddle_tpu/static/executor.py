@@ -332,7 +332,7 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           ps_config=None):
+                           ps_config=None, start_batch=0):
         """The industrial hot path (reference executor.py:1425
         _run_from_dataset -> framework/executor.cc:165 RunFromDataset ->
         HogwildWorker::TrainFiles hogwild_worker.cc:196).
@@ -352,7 +352,14 @@ class Executor:
         PS-managed params are pulled into the scope for the batch's ids,
         their grads are fetched and pushed as (ids, rows) pairs, and they
         are EXCLUDED from the program's local optimizer section — the
-        server's accessor owns the update rule."""
+        server's accessor owns the update rule.
+
+        start_batch resumes mid-epoch at the exact batch: the first N
+        batches are skipped (at the dataset's index level when it
+        supports batches(start_batch=...), by islice otherwise) and step
+        numbering continues from N — pair with the dataset's
+        state_dict()/load_state_dict() for a bit-exact data resume after
+        a trainer kill (docs/fault_tolerance.md "Trainer recovery")."""
         if dataset is None:
             raise ValueError("train_from_dataset requires a dataset")
         from ..core import flags as _flags
@@ -370,6 +377,16 @@ class Executor:
         if inflight is None:
             inflight = _flags.flag("FLAGS_executor_max_inflight")
 
+        start_batch = int(start_batch or 0)
+
+        def _batches():
+            try:
+                return dataset.batches(start_batch=start_batch)
+            except TypeError:
+                import itertools
+                return itertools.islice(dataset.batches(),
+                                        start_batch, None)
+
         if dp is None and inflight > 0:
             # async hot path: in-flight steps + device-resident carry +
             # (opt-in) scan-fused megasteps; fetches materialize only at
@@ -377,13 +394,13 @@ class Executor:
             from .pipeline_runner import PipelineRunner
             names = fetch_info or [getattr(f, "name", str(f))
                                    for f in (fetch_list or [])]
-            it = 0
+            it = start_batch
             with PipelineRunner(
                     self, program, fetch_list=base_fetch, scope=scope,
                     max_inflight=inflight,
                     scan_steps=getattr(es, "scan_fuse_steps", None)) \
                     as runner:
-                for handles in runner.run(dataset.batches()):
+                for handles in runner.run(_batches()):
                     _monitor.stat_add("executor/dataset_batches")
                     it += 1
                     if debug or (fetch_list and print_period
@@ -397,8 +414,9 @@ class Executor:
         # synchronous loop: the Downpour pre/post hooks read AND write the
         # scope around every batch (sparse pull into the param, grad rows
         # pushed after) — a per-step host sync boundary by construction
-        it = 0
-        for feed in dataset.batches():
+        from ..distributed import elastic as _elastic
+        it = start_batch
+        for feed in _batches():
             if dp is not None:
                 feed = dp.pre_step(feed)
             outs = self.run(program, feed=feed,
@@ -410,6 +428,7 @@ class Executor:
                 outs = outs[:len(base_fetch)]
             _monitor.stat_add("executor/dataset_batches")
             it += 1
+            _elastic.notify_step(it)
             if debug or (fetch_list and print_period
                          and it % print_period == 0):
                 names = fetch_info or [getattr(f, "name", str(f))

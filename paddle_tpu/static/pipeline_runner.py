@@ -201,6 +201,10 @@ class PipelineRunner:
         # one connected trace even when nothing opened a root span
         self._trace_ctx = _trace.current() or (_trace.new_trace_id(),
                                                None)
+        # liveness pulse: every dispatched step refreshes the active
+        # StallMonitor/Heartbeat listeners (distributed/elastic.py)
+        from ..distributed.elastic import notify_step
+        self._notify_step = notify_step
 
     # -- lifecycle -----------------------------------------------------------
     def __enter__(self):
@@ -354,6 +358,7 @@ class PipelineRunner:
         self._depth_peak = max(self._depth_peak, len(self._window))
         self._host_s += (r1 - t0) - (r1 - r0)
         _monitor.stat_add("executor/runs")
+        self._notify_step(idx + 1)
         return [FetchHandle(f, idx, self) for f in fetches]
 
     def submit_scan(self, stacked_feed, k):
@@ -417,6 +422,7 @@ class PipelineRunner:
         self._host_s += (r1 - t0) - (r1 - r0)
         _monitor.stat_add("executor/runs", k)
         _monitor.stat_add("executor/scan_megasteps")
+        self._notify_step(last + 1)
         return [[FetchHandle(f, first + i, self, row=i) for f in fetches]
                 for i in range(k)]
 
