@@ -138,6 +138,32 @@ define_flag("FLAGS_pallas_strict", False,
             "demotion bumps pallas.fallback.{kernel}.{reason} in "
             "core/monitor)")
 
+# --- continuous-batching decode serving (inference/serving.py,
+# --- nn/kv_pool.py, ops/pallas/decode_attention.py paged kernel) --------
+define_flag("FLAGS_use_paged_attention", True,
+            "route paged (block-table) decode attention through the "
+            "Pallas kernel (ops/pallas/decode_attention."
+            "paged_decode_attention): per-request block tables ride the "
+            "scalar-prefetch path next to the ragged lengths, so a "
+            "decode step's KV reads scale with each request's LIVE "
+            "blocks, not max_seq_len. Off, the serve loop runs the jnp "
+            "gather fallback (nn/kv_pool.paged_attention_ref)")
+define_flag("FLAGS_serve_block_size", 0,
+            "tokens per physical KV-pool block (nn/kv_pool.KVBlockPool); "
+            "0 = auto: the paged-decode autotune table on TPU, else the "
+            "128-column heuristic. Must be a multiple of the 8-row "
+            "sublane tile. Smaller blocks waste less pool memory per "
+            "short request; larger blocks amortize kernel grid overhead")
+define_flag("FLAGS_serve_kv_blocks", 512,
+            "physical blocks in the serving KV pool (per layer, k+v "
+            "arenas); the pool is the admission currency — waiting "
+            "requests stay queued until retiring streams free enough "
+            "blocks (inference/serving.py backpressure)")
+define_flag("FLAGS_serve_max_active", 64,
+            "decode slots in the serving batch: the fused per-step "
+            "decode processes this many concurrent streams (idle slots "
+            "are masked to the trash block, costing no KV reads)")
+
 define_flag("FLAGS_executor_max_inflight", 2,
             "async executor pipeline depth: how many dispatched-but-not-"
             "materialized steps the training hot loop keeps queued "

@@ -23,6 +23,20 @@ import numpy as np
 __all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor",
            "encrypt_model"]
 
+# the continuous-batching serve tier (serving.py) pulls in jax at import;
+# PEP-562 lazy exports keep `import paddle_tpu.inference` light for the
+# predictor-only deployment path (declared in __all_lazy__ so the API.spec
+# sweep still sees them — tools/gen_api_spec.py)
+__all_lazy__ = ["ServeLoop", "ServeConfig", "ServeRequest"]
+
+
+def __getattr__(name):
+    if name in __all_lazy__:
+        from . import serving
+        return getattr(serving, name)
+    raise AttributeError(
+        f"module 'paddle_tpu.inference' has no attribute {name!r}")
+
 
 def encrypt_model(prefix, key):
     """Encrypt the weight-bearing artifact at rest (reference model

@@ -1,0 +1,692 @@
+"""Continuous-batching decode serving — the online inference tier.
+
+`GPT.generate` is one-model-one-call: a fixed batch prefills together,
+decodes together, and every row that finishes early (or never existed)
+still burns a slot until the longest row is done. This module turns the
+same kernel-fast decode path into a SERVER:
+
+- **paged KV pool** (nn/kv_pool.py): all in-flight requests share one
+  physical block arena per layer; per-request block tables make ragged
+  lengths free and retiring requests return their blocks to the pool
+  immediately;
+- **prefill/decode split with admission scheduling**: new requests are
+  admitted when a slot AND enough pool blocks are free, prefilled as a
+  bucketed single-request pass (logits read at the real last prompt
+  token), then join the ONE fused decode batch that advances every
+  active stream one token per step through the block-table Pallas
+  kernel (per-step KV reads scale with live blocks, not max_seq_len);
+- **async pipeline**: decode steps dispatch through the PR 5
+  `InflightDriver` (static/pipeline_runner.py), so dispatch of step N+1
+  overlaps sampling/detokenization-side bookkeeping of step N; failures
+  surface as `PipelineStepError` naming the step;
+- **backpressure + preemption**: when the pool is exhausted, admissions
+  queue; when an ACTIVE stream cannot grow into a new block, the
+  youngest active stream is evicted (blocks freed, request re-queued
+  with its generated prefix — greedy/fold-in sampling makes the replay
+  deterministic) so the oldest stream always completes.
+
+Per-request sampling keys fold `PRNGKey(seed)` with the absolute token
+position, so a stream's tokens do not depend on which batch it rides in
+or whether it was preempted. Greedy (temperature=0) continuous-batched
+decode is token-identical to per-request sequential `GPT.generate`
+(tests/test_serving.py proves it bitwise).
+
+Observability: spans `serve/{admit,prefill,decode_step,retire,evict}`
+with a per-request flow chain, gauges `serve.{queue_depth,active_slots,
+kv_pool_used_blocks,kv_pool_free_blocks}`, counters `serve.{preempted,
+tokens_generated,requests_completed,requests_errored}`, histograms
+`serve/ttft_ms` and `serve/token_ms` — rendered by tools/obs_report.py's
+serving section and snapshotted by BENCH_MODE=serve.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["ServeConfig", "ServeRequest", "ServeLoop",
+           "build_decode_step"]
+
+GAUGES = ("serve.queue_depth", "serve.active_slots",
+          "serve.kv_pool_used_blocks", "serve.kv_pool_free_blocks")
+COUNTERS = ("serve.preempted", "serve.tokens_generated",
+            "serve.requests_completed", "serve.requests_errored")
+
+_REQ_IDS = itertools.count()
+
+
+@dataclass
+class ServeConfig:
+    """Knobs for one ServeLoop. Zeros mean "take the FLAGS_serve_*
+    default" (core/flags.py) so a deployment can be tuned per-job via
+    env without touching code."""
+
+    max_active: int = 0     # decode slots (FLAGS_serve_max_active)
+    kv_blocks: int = 0      # pool blocks (FLAGS_serve_kv_blocks)
+    block_size: int = 0     # tokens/block (FLAGS_serve_block_size / auto)
+    max_seq_len: int = 0    # per-request cap (0 = model max_seq_len)
+    temperature: float = 0.0
+    top_k: int = None
+    eos_token_id: int = None   # default; per-request override wins
+    max_inflight: int = 0      # decode pipeline depth (0 = executor flag)
+
+    def resolve(self, net):
+        from ..core import flags as _flags
+        cfg = net.config
+        max_active = int(self.max_active
+                         or _flags.flag("FLAGS_serve_max_active"))
+        kv_blocks = int(self.kv_blocks
+                        or _flags.flag("FLAGS_serve_kv_blocks"))
+        max_seq = int(self.max_seq_len or cfg.max_seq_len)
+        max_seq = min(max_seq, cfg.max_seq_len)
+        if self.block_size:
+            block_size = int(self.block_size)
+        else:
+            from ..nn.kv_pool import pick_block_size
+            block_size = pick_block_size(
+                max_seq, cfg.num_heads, cfg.hidden_size // cfg.num_heads)
+        max_inflight = int(self.max_inflight
+                           or _flags.flag("FLAGS_executor_max_inflight"))
+        return max_active, kv_blocks, block_size, max_seq, \
+            max(1, max_inflight)
+
+
+class ServeRequest:
+    """One generate stream. Clients hold this as a future: `result()`
+    blocks until the stream finishes (or raises its error)."""
+
+    def __init__(self, prompt, max_new_tokens, eos_token_id, seed):
+        self.rid = next(_REQ_IDS)
+        self.prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.eos_token_id = eos_token_id
+        self.seed = int(seed)
+        self.out = []            # generated token ids (host ints)
+        self.error = None
+        self.preemptions = 0
+        self.t_submit = time.perf_counter()
+        self.t_first = None      # first generated token materialized
+        self.t_done = None
+        self._done = threading.Event()
+
+    # -- future API ---------------------------------------------------------
+    @property
+    def done(self):
+        return self._done.is_set()
+
+    def wait(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.rid} still in flight")
+        return self
+
+    def result(self, timeout=None):
+        """Generated tokens [n] (prompt excluded). Raises the request's
+        error if serving failed it."""
+        self.wait(timeout)
+        if self.error is not None:
+            raise self.error
+        return np.asarray(self.out, np.int64)
+
+    # -- latency metrics ----------------------------------------------------
+    @property
+    def ttft_s(self):
+        return None if self.t_first is None else self.t_first - self.t_submit
+
+    @property
+    def per_token_s(self):
+        if self.t_done is None or self.t_first is None or len(self.out) < 2:
+            return None
+        return (self.t_done - self.t_first) / (len(self.out) - 1)
+
+
+def _sampler(temperature, top_k):
+    """Per-row sampler: greedy at temperature=0, else categorical keyed
+    by fold_in(request_key, absolute token position) — batch-composition
+    independent and preemption-replay stable."""
+    import jax
+    import jax.numpy as jnp
+
+    if temperature == 0:
+        def greedy(logits, keys, positions):
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return greedy
+
+    def sample(logits, keys, positions):
+        def one(lg, key, pos):
+            k = jax.random.fold_in(key, pos)
+            lg = lg.astype(jnp.float32) / temperature
+            if top_k is not None:
+                kth = jax.lax.top_k(lg, int(top_k))[0][-1]
+                lg = jnp.where(lg < kth, -1e9, lg)
+            return jax.random.categorical(k, lg).astype(jnp.int32)
+        return jax.vmap(one)(logits, keys, positions)
+    return sample
+
+
+def build_decode_step(net, temperature=0.0, top_k=None):
+    """The UN-jitted fused decode step: every active stream advances one
+    token. (params, buffers, arenas, block_tables, lengths, tokens,
+    keys) -> (new_arenas, next_tokens). Exposed at module level so
+    tools/hlo_evidence.py can AOT-lower the PRODUCTION step — the
+    evidence cannot drift from the loop."""
+    import jax.numpy as jnp
+
+    from ..core import tape as _tape
+    from ..nn.kv_pool import PagedKVCache
+
+    samp = _sampler(temperature, top_k)
+
+    def decode_step(params, buffers, arenas, block_tables, lengths,
+                    tokens, keys):
+        with _tape.no_grad():
+            net.load_functional_state(params, buffers)
+            caches = [PagedKVCache(k, v, block_tables, lengths)
+                      for (k, v) in arenas]
+            logits, new_caches = net._forward_paged(tokens[:, None],
+                                                    caches)
+            nxt = samp(logits, keys, lengths + jnp.int32(1))
+        return [(c.k, c.v) for c in new_caches], nxt
+
+    return decode_step
+
+
+def _build_prefill(net, temperature, top_k):
+    """The UN-jitted bucketed prefill: one request's (padded) prompt
+    writes its k/v into the pool blocks and samples the first token,
+    which is also spliced into the fused batch's token carry at `slot`.
+    (params, buffers, arenas, tokens, bt_row, ids, real_len, key, slot)
+    -> ((new_arenas, new_tokens), first_token)."""
+    import jax.numpy as jnp
+
+    from ..core import tape as _tape
+    from ..nn.kv_pool import PagedKVCache
+
+    samp = _sampler(temperature, top_k)
+
+    def prefill(params, buffers, arenas, tokens, bt_row, ids, real_len,
+                key, slot):
+        with _tape.no_grad():
+            net.load_functional_state(params, buffers)
+            caches = [PagedKVCache(k, v, bt_row, jnp.zeros((1,),
+                                                           jnp.int32))
+                      for (k, v) in arenas]
+            logits, new_caches = net._forward_paged(
+                ids, caches, last_index=jnp.reshape(real_len, (1,)) - 1)
+            first = samp(logits, key[None], jnp.reshape(real_len,
+                                                        (1,)))[0]
+            tokens = tokens.at[slot].set(first)
+        return ([(c.k, c.v) for c in new_caches], tokens), first
+
+    return prefill
+
+
+class _Slot:
+    __slots__ = ("req", "length", "blocks", "version", "admit_seq",
+                 "key")
+
+    def __init__(self, req, blocks, version, admit_seq, key):
+        self.req = req
+        self.length = 0          # tokens written into the cache
+        self.blocks = blocks     # physical block ids (pool-owned)
+        self.version = version
+        self.admit_seq = admit_seq
+        self.key = key           # raw uint32[2] PRNGKey data
+
+
+class ServeLoop:
+    """Continuous-batching server over one (eval-mode) GPT-style model.
+
+    Batch use:  `ServeLoop(net).serve(prompts)` drives the caller thread.
+    Server use: `start()` spawns the scheduler thread; any number of
+    client threads `submit(...).result()`. `stop()` drains and joins.
+    """
+
+    def __init__(self, net, config=None, **overrides):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core import flags as _flags  # noqa: F401 (resolve below)
+        from ..nn.kv_pool import KVBlockPool
+        from ..static.pipeline_runner import _FLOW_NS, InflightDriver
+
+        self.net = net
+        self.config = config or ServeConfig(**overrides)
+        if overrides and config is not None:
+            raise ValueError("pass either a ServeConfig or kwargs")
+        (self._A, n_blocks, self._bs, self._cap,
+         self._max_inflight) = self.config.resolve(net)
+        cfg = net.config
+        if net.training:
+            net.eval()  # decode kernels are eval-only; serving never drops
+        self._pool = KVBlockPool(n_blocks, self._bs)
+        self._MB = -(-self._cap // self._bs)     # block-table width
+        self._params, self._buffers = net.functional_state()
+        self._dtype = jnp.bfloat16 if any(
+            v.dtype == jnp.bfloat16 for v in self._params.values()) \
+            else jnp.float32
+        heads, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        self._arenas = self._pool.arenas(cfg.num_layers, heads, hd,
+                                         self._dtype)
+        self._tokens = jnp.zeros((self._A,), jnp.int32)
+        self._driver = InflightDriver("serve",
+                                      max_inflight=self._max_inflight)
+        self._flow_base = next(_FLOW_NS) << 42  # per-request flow chain
+
+        step = build_decode_step(net, self.config.temperature,
+                                 self.config.top_k)
+        # donate the big arenas only: the [A] token carry is ALSO step
+        # N's fetch, and donating it into step N+1 would delete the
+        # buffer out from under the in-flight FetchHandle
+        self._step_jit = jax.jit(step, donate_argnums=(2,))
+        pf = _build_prefill(net, self.config.temperature,
+                            self.config.top_k)
+        self._prefill_jit = jax.jit(pf, donate_argnums=(2,))
+        self._traced = set()   # (kind, bucket) keys already traced
+
+        self._slots = [None] * self._A
+        self._queue: deque = deque()
+        self._pending: deque = deque()  # settle entries, driver order
+        self._version = 0
+        self._admit_seq = 0
+        self._step_count = 0
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._thread = None
+        self._stopping = False
+
+    # -- public API ----------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=32, eos_token_id=None,
+               seed=0):
+        """Enqueue one generate stream; returns its ServeRequest
+        future. Thread-safe."""
+        eos = self.config.eos_token_id if eos_token_id is None \
+            else eos_token_id
+        req = ServeRequest(prompt, max_new_tokens, eos, seed)
+        total = req.prompt.size + req.max_new_tokens
+        if total > self._cap:
+            raise ValueError(
+                f"request needs {total} tokens > serving cap {self._cap}")
+        if self._pool.blocks_for(total) > self._pool.n_blocks:
+            raise ValueError(
+                f"request needs {self._pool.blocks_for(total)} blocks > "
+                f"pool size {self._pool.n_blocks}")
+        with self._work:
+            self._queue.append(req)
+            self._work.notify_all()
+        return req
+
+    def serve(self, prompts, **kw):
+        """Batch convenience: submit every prompt, drive the scheduler
+        on the caller thread until idle, return the generated-token
+        arrays in order."""
+        if self._thread is not None:
+            raise RuntimeError("serve() on a started loop; use submit()")
+        reqs = [self.submit(p, **kw) for p in prompts]
+        self.run_until_idle()
+        return [r.result(timeout=0) for r in reqs]
+
+    def run_until_idle(self):
+        """Drive scheduler ticks on the caller thread until no queued,
+        active, or in-flight work remains."""
+        while self._has_work():
+            self._tick()
+        self._drain()
+
+    def start(self):
+        """Background-server mode: scheduler runs on its own thread."""
+        if self._thread is not None:
+            return self
+        self._stopping = False
+        self._thread = threading.Thread(target=self._serve_forever,
+                                        daemon=True, name="serve-loop")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=30):
+        """Finish in-flight + queued work, then stop the thread. Raises
+        on timeout instead of orphaning the scheduler — clearing
+        `_thread` while it still runs would let a later start() race a
+        second scheduler over the (single-owner) pool and slots."""
+        t = self._thread
+        if t is None:
+            return
+        with self._work:
+            self._stopping = True
+            self._work.notify_all()
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError(
+                f"serve loop did not drain within {timeout}s "
+                f"({self.stats()})")
+        self._thread = None
+
+    def stats(self):
+        return {
+            "queue_depth": len(self._queue),
+            "active_slots": sum(s is not None for s in self._slots),
+            "kv_pool_used_blocks": self._pool.used_blocks,
+            "kv_pool_free_blocks": self._pool.free_blocks,
+            "steps": self._step_count,
+            "block_size": self._bs,
+            "max_active": self._A,
+        }
+
+    # -- scheduler ----------------------------------------------------------
+    def _has_work(self):
+        return bool(self._queue or self._pending
+                    or any(s is not None for s in self._slots))
+
+    def _serve_forever(self):
+        while True:
+            with self._work:
+                while not self._has_work() and not self._stopping:
+                    self._work.wait(timeout=0.05)
+                if self._stopping and not self._has_work():
+                    return
+            self._tick()
+
+    def _tick(self):
+        """One scheduler beat: settle enough of the pipeline to bound
+        the window, admit, grow/preempt, dispatch the next fused decode
+        step (N+1 overlapping the settle of step N)."""
+        while len(self._pending) >= self._max_inflight:
+            self._settle_one()
+        self._admit()
+        if any(s is not None for s in self._slots):
+            self._grow_or_preempt()
+            self._dispatch_decode()
+        elif self._pending:
+            self._settle_one()
+        self._publish_gauges()
+
+    def _drain(self):
+        while self._pending:
+            self._settle_one()
+        self._publish_gauges()
+
+    # -- admission / prefill -------------------------------------------------
+    def _free_slot(self):
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def _admit(self):
+        from ..core import trace as _trace
+        while True:
+            with self._lock:
+                req = self._queue[0] if self._queue else None
+            if req is None:
+                return
+            idx = self._free_slot()
+            if idx is None:
+                return
+            prompt = np.concatenate(
+                [req.prompt, np.asarray(req.out, np.int64)]) \
+                if req.out else req.prompt
+            remaining = req.max_new_tokens - len(req.out)
+            need_total = self._pool.blocks_for(prompt.size + remaining)
+            # BACKPRESSURE: the head of the queue waits (FCFS — no
+            # starvation of long requests) until retiring streams free
+            # enough blocks for its whole worst case
+            if not self._pool.can_alloc(need_total):
+                return
+            with self._lock:
+                self._queue.popleft()
+            blocks = self._pool.alloc(self._pool.blocks_for(prompt.size))
+            with _trace.span("serve/admit", req=req.rid, slot=idx,
+                             prompt_len=int(prompt.size),
+                             blocks=len(blocks)) as sp:
+                sp.flow(self._flow_base + req.rid, "s")
+                import jax
+                self._version += 1
+                self._admit_seq += 1
+                key = np.asarray(jax.random.PRNGKey(req.seed),
+                                 np.uint32)
+                slot = _Slot(req, blocks, self._version,
+                             self._admit_seq, key)
+                self._slots[idx] = slot
+                self._dispatch_prefill(idx, slot, prompt)
+
+    def _bucket(self, n):
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def _call_traced(self, fn, key, *args):
+        """Call a jitted fn; after its FIRST trace (which rebinds the
+        live layers' parameters to tracers) restore the real arrays so
+        eager use of the net keeps working (same contract as
+        GPT._generate_cached)."""
+        if key in self._traced:
+            return fn(*args)
+        try:
+            return fn(*args)
+        finally:
+            self.net.load_functional_state(self._params, self._buffers)
+            self._traced.add(key)
+
+    def _dispatch_prefill(self, idx, slot, prompt):
+        import jax.numpy as jnp
+
+        from ..core import trace as _trace
+        req = slot.req
+        s_real = int(prompt.size)
+        bucket = self._bucket(s_real)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :s_real] = prompt
+        bt_row = np.zeros((1, self._MB), np.int32)
+        bt_row[0, :len(slot.blocks)] = slot.blocks
+        with _trace.span("serve/prefill", req=req.rid, slot=idx,
+                         prompt_len=s_real, bucket=bucket) as sp:
+            sp.flow(self._flow_base + req.rid, "t")
+
+            def thunk():
+                carry, first = self._call_traced(
+                    self._prefill_jit, ("prefill", bucket),
+                    self._params, self._buffers, self._arenas,
+                    self._tokens, jnp.asarray(bt_row), jnp.asarray(ids),
+                    jnp.int32(s_real), jnp.asarray(slot.key),
+                    jnp.int32(idx))
+                return carry, [first]
+
+            carry, handles = self._driver.submit(thunk, kind="prefill",
+                                                 req=req.rid)
+        if carry is not None:
+            self._arenas, self._tokens = carry
+        slot.length = s_real
+        self._pending.append(("prefill", handles, req, idx,
+                              slot.version))
+
+    # -- growth / preemption -------------------------------------------------
+    def _youngest_active(self):
+        best = None
+        for i, s in enumerate(self._slots):
+            if s is not None and (best is None
+                                  or s.admit_seq
+                                  > self._slots[best].admit_seq):
+                best = i
+        return best
+
+    def _grow_or_preempt(self):
+        """Every active slot writes its next token at position `length`
+        this step; make sure the covering block exists, evicting the
+        youngest stream when the pool is dry (oldest always wins)."""
+        order = sorted((i for i, s in enumerate(self._slots)
+                        if s is not None),
+                       key=lambda i: self._slots[i].admit_seq)
+        for idx in order:
+            slot = self._slots[idx]
+            if slot is None:          # evicted by an earlier iteration
+                continue
+            need_blk = slot.length // self._bs
+            while need_blk >= len(slot.blocks):
+                got = self._pool.alloc(1)
+                if got is not None:
+                    slot.blocks.extend(got)
+                    continue
+                victim = self._youngest_active()
+                self._preempt(victim)
+                if victim == idx:
+                    break             # preempted ourselves; slot is gone
+
+    def _preempt(self, idx):
+        from ..core import monitor as _monitor
+        from ..core import trace as _trace
+        slot = self._slots[idx]
+        req = slot.req
+        with _trace.span("serve/evict", req=req.rid, slot=idx,
+                         generated=len(req.out),
+                         blocks=len(slot.blocks)) as sp:
+            sp.flow(self._flow_base + req.rid, "t")
+            self._pool.free(slot.blocks)
+            self._slots[idx] = None
+            req.preemptions += 1
+            _monitor.stat_add("serve.preempted")
+            with self._lock:
+                # back to the head: it is older than everything queued,
+                # and its re-prefill (prompt + generated prefix) replays
+                # the same token stream
+                self._queue.appendleft(req)
+
+    # -- decode dispatch -----------------------------------------------------
+    def _dispatch_decode(self):
+        import jax.numpy as jnp
+
+        from ..core import trace as _trace
+        A, MB = self._A, self._MB
+        lengths = np.zeros((A,), np.int32)
+        bt = np.zeros((A, MB), np.int32)
+        keys = np.zeros((A, 2), np.uint32)
+        snapshot = []
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            lengths[i] = s.length
+            bt[i, :len(s.blocks)] = s.blocks
+            keys[i] = s.key
+            snapshot.append((i, s.req, s.version))
+        step_idx = self._step_count
+        self._step_count += 1
+        with _trace.span("serve/decode_step", step=step_idx,
+                         active=len(snapshot)):
+
+            def thunk():
+                arenas, nxt = self._call_traced(
+                    self._step_jit, ("decode",),
+                    self._params, self._buffers, self._arenas,
+                    jnp.asarray(bt), jnp.asarray(lengths), self._tokens,
+                    jnp.asarray(keys))
+                return (arenas, nxt), [nxt]
+
+            carry, handles = self._driver.submit(thunk, kind="decode",
+                                                 active=len(snapshot))
+        if carry is not None:
+            self._arenas, self._tokens = carry
+        for i, _req, _ver in snapshot:
+            self._slots[i].length += 1
+        self._pending.append(("decode", handles, snapshot))
+
+    # -- settlement / retirement --------------------------------------------
+    def _settle_one(self):
+        from ..static.pipeline_runner import PipelineStepError
+        entry = self._pending.popleft()
+        try:
+            toks = np.asarray(entry[1][0])
+        except PipelineStepError as exc:
+            self._fail_inflight(exc)
+            return
+        now = time.perf_counter()
+        if entry[0] == "prefill":
+            _kind, _h, req, idx, version = entry
+            slot = self._slots[idx]
+            if slot is None or slot.version != version:
+                return               # preempted before its first token
+            self._append_token(idx, slot, int(toks), now, first=True)
+            return
+        _kind, _h, snapshot = entry
+        for idx, req, version in snapshot:
+            slot = self._slots[idx]
+            if slot is None or slot.version != version \
+                    or slot.req is not req:
+                continue             # retired/preempted mid-flight
+            self._append_token(idx, slot, int(toks[idx]), now)
+
+    def _append_token(self, idx, slot, token, now, first=False):
+        from ..core import monitor as _monitor
+        req = slot.req
+        if first and req.t_first is None and not req.out:
+            req.t_first = now
+        req.out.append(token)
+        _monitor.stat_add("serve.tokens_generated")
+        if (req.eos_token_id is not None and token == req.eos_token_id) \
+                or len(req.out) >= req.max_new_tokens:
+            self._retire(idx, slot)
+
+    def _retire(self, idx, slot):
+        """Finished stream: free its blocks IMMEDIATELY (they are the
+        admission currency for whoever is queued) and complete the
+        future. In-flight steps that still carry this slot are ignored
+        at settle via the slot version."""
+        from ..core import monitor as _monitor
+        from ..core import trace as _trace
+        req = slot.req
+        with _trace.span("serve/retire", req=req.rid, slot=idx,
+                         generated=len(req.out),
+                         blocks=len(slot.blocks)) as sp:
+            sp.flow(self._flow_base + req.rid, "f")
+            self._pool.free(slot.blocks)
+            self._slots[idx] = None
+            req.t_done = time.perf_counter()
+            _monitor.stat_add("serve.requests_completed")
+            if req.ttft_s is not None:
+                _monitor.observe("serve/ttft_ms", req.ttft_s * 1e3)
+            if req.per_token_s is not None:
+                _monitor.observe("serve/token_ms", req.per_token_s * 1e3)
+            req._done.set()
+
+    def _fail_inflight(self, exc):
+        """A decode/prefill step died (XLA-level, past run_guarded): the
+        donated device chain is poisoned. Fail every in-flight stream,
+        rebuild the device state, keep serving the queue."""
+        import jax.numpy as jnp
+
+        from ..core import monitor as _monitor
+        from ..static.pipeline_runner import InflightDriver
+        self._pending.clear()
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            slot.req.error = exc
+            slot.req.t_done = time.perf_counter()
+            slot.req._done.set()
+            self._pool.free(slot.blocks)
+            self._slots[i] = None
+            _monitor.stat_add("serve.requests_errored")
+        cfg = self.net.config
+        heads, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        self._arenas = self._pool.arenas(cfg.num_layers, heads, hd,
+                                         self._dtype)
+        self._tokens = jnp.zeros((self._A,), jnp.int32)
+        self._driver = InflightDriver("serve",
+                                      max_inflight=self._max_inflight)
+
+    # -- gauges --------------------------------------------------------------
+    def _publish_gauges(self):
+        from ..core import monitor as _monitor
+        _monitor.stat_set_many({
+            "serve.queue_depth": len(self._queue),
+            "serve.active_slots": sum(s is not None
+                                      for s in self._slots),
+            "serve.kv_pool_used_blocks": self._pool.used_blocks,
+            "serve.kv_pool_free_blocks": self._pool.free_blocks,
+        })

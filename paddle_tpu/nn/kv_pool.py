@@ -1,0 +1,250 @@
+"""Paged KV-cache pool — the serving tier's shared decode cache.
+
+`StaticKVCache` (nn/layer/transformer.py) preallocates a private
+[b, h, max_seq_len, d] slab per batch row. That is the right shape for
+ONE generate() call, and exactly the wrong shape for a serve loop:
+requests arrive with different lengths, finish at different times, and a
+fixed-batch slab burns max_seq_len slots of HBM per row whether the row
+holds a 2000-token context or an idle slot. This module is the vLLM-style
+fix, TPU-native:
+
+- **arena**: one physical [n_blocks + 1, h, block_size, d] buffer per
+  layer per k/v (`PagedKVCache`). Physical block 0 is RESERVED as the
+  trash block — writes from masked/inactive rows and table entries past a
+  request's allocation all land there, so the kernel's index maps never
+  need a branch;
+- **block table**: each request maps logical block j -> physical row
+  `block_tables[i, j]`; unallocated entries are 0 (trash) by contract;
+- **free list**: `KVBlockPool` hands physical blocks out and takes them
+  back the moment a request retires — the pool is the serving tier's
+  admission currency (inference/serving.py blocks admissions on it).
+
+Attention over the paged layout dispatches to the block-table Pallas
+kernel (ops/pallas/decode_attention.paged_decode_attention — lengths AND
+block tables ride the scalar-prefetch path, so per-step KV bytes scale
+with live blocks, not max_seq_len) behind the same gate + run_guarded
+discipline as every other kernel; `paged_attention_ref` is the jnp
+fallback and the parity oracle.
+"""
+from __future__ import annotations
+
+import typing
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PagedKVCache", "KVBlockPool", "paged_attention",
+           "paged_attention_ref", "write_kv", "pick_block_size"]
+
+TRASH_BLOCK = 0  # physical row 0 of every arena; never allocated
+
+
+class PagedKVCache(typing.NamedTuple):
+    """One layer's paged decode cache. `k`/`v` are the physical arenas
+    [n_blocks + 1, h, block_size, d] (row 0 = trash); `block_tables`
+    [b, max_blocks] i32 maps each request-slot's logical blocks to
+    physical rows (unallocated entries 0); `lengths` [b] i32 counts the
+    tokens already written per slot. A pytree — jit/scan-able, and the
+    block_tables/lengths leaves are shared by reference across layers."""
+
+    k: object             # [n_blocks + 1, h, block_size, d]
+    v: object             # [n_blocks + 1, h, block_size, d]
+    block_tables: object  # [b, max_blocks] i32
+    lengths: object       # [b] i32
+
+    @property
+    def block_size(self):
+        return int(self.k.shape[2])
+
+
+def pick_block_size(max_seq_len, heads, head_dim, dtype="float32",
+                    batch=1):
+    """Pool block size = the paged kernel's KV block: FLAGS_serve_block_size
+    override, else the decode-attention autotune table (measured on TPU,
+    disk-cached — same (kernel, shape-bucket, dtype) key family as the
+    contiguous kernel), else the 128-column heuristic clamped to the
+    sequence budget. Always a multiple of the 8-row sublane tile."""
+    from ..core import flags as _flags
+    from ..ops.pallas import autotune
+    from ..ops.pallas.flash_attention import _ceil_to, _pick_block
+    L = _ceil_to(max(int(max_seq_len), 8), 8)
+    cfg = int(_flags.flag("FLAGS_serve_block_size") or 0)
+    if cfg:
+        if cfg % 8 != 0:
+            raise ValueError(
+                f"FLAGS_serve_block_size={cfg} must be a multiple of 8")
+        return cfg
+    default = _pick_block(L, 128) or 8
+
+    def measure(params):
+        (bs_,) = params
+        nb = max(L // bs_, 1)
+        h, d = int(heads), int(head_dim)
+        ka = jnp.zeros((nb + 1, h, bs_, d), dtype)
+        q = jnp.zeros((batch, h, 8, d), dtype)
+        bt = jnp.tile(jnp.arange(1, nb + 1, dtype=jnp.int32), (batch, 1))
+        lens = jnp.full((batch,), nb * bs_, jnp.int32)
+        from ..ops.pallas.decode_attention import _paged_call
+        fn = jax.jit(lambda a, k_, v_, b_, ln: _paged_call(
+            a, k_, v_, b_, ln, float(d) ** -0.5))
+        return autotune.time_thunk(lambda: fn(q, ka, ka, bt, lens))
+
+    cands = [(x,) for x in (256, 128, 64) if L % x == 0]
+    if len(cands) <= 1:
+        return default
+    return autotune.lookup(
+        "paged_decode_attention", (autotune.bucket(L), int(head_dim)),
+        str(jnp.dtype(dtype)), cands, measure, (default,))[0]
+
+
+class KVBlockPool:
+    """Host-side free-list over the physical arena rows. NOT thread-safe:
+    the serve loop owns it from one scheduler thread. Block ids are 1-based
+    (0 is the trash block)."""
+
+    def __init__(self, n_blocks, block_size):
+        if n_blocks < 1:
+            raise ValueError("KVBlockPool needs at least one block")
+        if block_size < 8 or block_size % 8 != 0:
+            raise ValueError(
+                f"block_size {block_size} must be a multiple of the 8-row "
+                "sublane tile")
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        # LIFO free list: a just-freed block is hot in whatever cache
+        # hierarchy the arena write path touches next
+        self._free = list(range(self.n_blocks, 0, -1))
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    @property
+    def used_blocks(self):
+        return self.n_blocks - len(self._free)
+
+    def blocks_for(self, n_tokens):
+        """Blocks needed to hold n_tokens."""
+        return max(0, -(-int(n_tokens) // self.block_size))
+
+    def can_alloc(self, n):
+        return len(self._free) >= int(n)
+
+    def alloc(self, n):
+        """Pop n physical block ids; returns None (and takes nothing)
+        when the pool can't satisfy the whole request — allocation is
+        all-or-nothing so a failed admission never leaks blocks."""
+        n = int(n)
+        if n < 0 or len(self._free) < n:
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks):
+        for b in blocks:
+            b = int(b)
+            if b < 1 or b > self.n_blocks:
+                raise ValueError(f"free of invalid block id {b}")
+            if b in self._free:  # double-free is a scheduler bug
+                raise ValueError(f"double free of block {b}")
+            self._free.append(b)
+
+    def arenas(self, layers, heads, head_dim, dtype=jnp.float32):
+        """Fresh zeroed k/v arena pairs, one per layer:
+        [(k, v), ...] each [n_blocks + 1, h, block_size, d] (row 0 =
+        trash). Zeros, not empty: a fresh pool must attend to nothing."""
+        shape = (self.n_blocks + 1, int(heads), self.block_size,
+                 int(head_dim))
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in range(int(layers))]
+
+
+# --------------------------------------------------------------------------
+# functional pieces used inside jitted serve steps
+# --------------------------------------------------------------------------
+
+def write_kv(arena, block_tables, lengths, new_kv):
+    """Scatter a chunk's k (or v) into the paged arena. `new_kv` is
+    [b, s, h, d] — the s new tokens per slot land at logical positions
+    lengths[i]..lengths[i]+s-1. Positions past a slot's table (or rows
+    the scheduler parked with an all-zero table) redirect to the trash
+    block, so masked/padded rows can never corrupt another request."""
+    b, s = new_kv.shape[0], new_kv.shape[1]
+    bs = arena.shape[2]
+    nb = block_tables.shape[1]
+    pos = (jnp.asarray(lengths, jnp.int32)[:, None]
+           + jnp.arange(s, dtype=jnp.int32)[None])        # [b, s]
+    blk_raw = pos // bs
+    blk = jnp.minimum(blk_raw, nb - 1)
+    phys = jnp.take_along_axis(jnp.asarray(block_tables, jnp.int32),
+                               blk, axis=1)               # [b, s]
+    phys = jnp.where(blk_raw < nb, phys, TRASH_BLOCK)
+    off = pos % bs
+    return arena.at[phys, :, off].set(new_kv.astype(arena.dtype))
+
+
+def paged_attention_ref(q, k_arena, v_arena, block_tables, lengths,
+                        scale):
+    """jnp fallback / parity oracle: gather each slot's blocks into a
+    contiguous [b, h, max_blocks*bs, d] view and run the same masked
+    softmax as _static_cache_attention, with per-row live lengths. Row r
+    of slot i attends logical cols <= lengths[i] + r."""
+    b, h, s, d = q.shape
+    bs = k_arena.shape[2]
+    bt = jnp.asarray(block_tables, jnp.int32)
+    nb = bt.shape[1]
+    L = nb * bs
+
+    def gather(arena):
+        g = jnp.take(arena, bt, axis=0)          # [b, nb, h, bs, d]
+        return jnp.moveaxis(g, 2, 1).reshape(b, h, L, d)
+
+    kc, vc = gather(k_arena), gather(v_arena)
+    lens = jnp.asarray(lengths, jnp.int32)
+    row = (lens[:, None] + jnp.arange(s, dtype=jnp.int32)[None])  # [b, s]
+    col = jnp.arange(L, dtype=jnp.int32)                          # [L]
+    live = col[None, None, :] <= row[:, :, None]                  # [b, s, L]
+    scores = jnp.einsum("bhsd,bhld->bhsl", q.astype(kc.dtype), kc,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(live[:, None], scores, -1e9)
+    p = jax.nn.softmax(scores, axis=-1).astype(vc.dtype)
+    return jnp.einsum("bhsl,bhld->bhsd", p, vc).astype(q.dtype)
+
+
+def _paged_kernel_eligible(q, k_arena, training):
+    """Gate for the block-table Pallas kernel; every rejection bumps
+    pallas.gate_reject.paged_decode_attention.{reason} so bench/serve
+    output can say why the pool path ran on jnp."""
+    from ..core import flags as _flags
+    from ..ops.pallas import gate_reject
+    if not _flags.flag("FLAGS_use_paged_attention"):
+        return gate_reject("paged_decode_attention", "flag_off")
+    from . import functional as F
+    if not F._pallas_backend_ok():
+        return gate_reject("paged_decode_attention", "backend")
+    if training:
+        # eval-only, like the contiguous decode kernel (no dropout/vjp)
+        return gate_reject("paged_decode_attention", "training")
+    from ..ops.pallas.decode_attention import paged_supported
+    if not paged_supported(tuple(q.shape), tuple(k_arena.shape)):
+        return gate_reject("paged_decode_attention", "shape")
+    return True
+
+
+def paged_attention(q, k_arena, v_arena, block_tables, lengths, scale,
+                    training=False):
+    """Gated + crash-guarded paged attention: the Pallas block-table
+    kernel when eligible, `paged_attention_ref` otherwise (and on any
+    kernel failure, via ops/pallas.run_guarded)."""
+    if _paged_kernel_eligible(q, k_arena, training):
+        from ..ops.pallas import run_guarded
+        from ..ops.pallas.decode_attention import paged_decode_attention
+        return run_guarded(
+            "paged_decode_attention",
+            lambda: paged_decode_attention(q, k_arena, v_arena,
+                                           block_tables, lengths, scale),
+            lambda: paged_attention_ref(q, k_arena, v_arena, block_tables,
+                                        lengths, scale))
+    return paged_attention_ref(q, k_arena, v_arena, block_tables, lengths,
+                               scale)

@@ -150,6 +150,36 @@ class MultiHeadAttention(Layer):
             q, k, v = self.q_proj(query), self.k_proj(key), self.v_proj(value)
 
         q, k, v = self._split_heads(q), self._split_heads(k), self._split_heads(v)
+        if cache is not None:
+            from ..kv_pool import PagedKVCache
+        if cache is not None and isinstance(cache, PagedKVCache):
+            # paged (block-table) decode path: the serving tier's shared
+            # arena (nn/kv_pool.py). Same contract as StaticKVCache —
+            # write the chunk's k/v, attend with causality from the
+            # per-slot fill counts — but the cache is a physical block
+            # arena shared across requests, indirected per slot.
+            if attn_mask is not None:
+                raise ValueError(
+                    "attn_mask is not supported with a PagedKVCache: "
+                    "causality comes from the per-slot lengths.")
+            from ..kv_pool import paged_attention, write_kv
+            import jax.numpy as jnp
+            kj = ops.transpose(k, [0, 2, 1, 3])._value  # [b, s, h, d]
+            vj = ops.transpose(v, [0, 2, 1, 3])._value
+            lens = jnp.asarray(cache.lengths, jnp.int32)
+            kc = write_kv(cache.k, cache.block_tables, lens, kj)
+            vc = write_kv(cache.v, cache.block_tables, lens, vj)
+            qv = q._value
+            out = paged_attention(qv, kc, vc, cache.block_tables, lens,
+                                  self.head_dim ** -0.5,
+                                  training=self.training)
+            from ...core.tensor import Tensor
+            out = ops.transpose(Tensor(out, _internal=True), [0, 2, 1, 3])
+            b, s = out.shape[0], out.shape[1]
+            out = self.out_proj(ops.reshape(out, [b, s, self.embed_dim]))
+            new_cache = PagedKVCache(kc, vc, cache.block_tables,
+                                     lens + jnp.int32(qv.shape[2]))
+            return out, new_cache
         if isinstance(cache, StaticKVCache):
             if attn_mask is not None:
                 raise ValueError(

@@ -39,7 +39,8 @@ from jax.experimental import pallas as pl
 from .flash_attention import (NEG_INF, _ceil_to, _cparams, _interpret,
                               _pick_block, _vmem)
 
-__all__ = ["decode_attention", "supported"]
+__all__ = ["decode_attention", "supported",
+           "paged_decode_attention", "paged_supported"]
 
 
 def _decode_attn_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
@@ -166,6 +167,178 @@ def _pick_bk(shape, dtype, scale, measure_builder):
         "decode_attention",
         (autotune.bucket(L_p), autotune.bucket(s_p), d),
         dtype, cands, measure_builder(), (default,))[0]
+
+
+# --------------------------------------------------------------------------
+# block-table (paged) variant: the serving tier's kernel
+# --------------------------------------------------------------------------
+#
+# The contiguous kernel above assumes each batch row owns a private
+# [L, d] cache slab. The continuous-batching serve loop
+# (inference/serving.py) instead shares ONE physical arena
+# [n_blocks, h, block_size, d] across every in-flight request
+# (nn/kv_pool.py): request i's logical block j lives at physical row
+# block_tables[i, j]. The only change the indirection needs is in the
+# K/V BlockSpec index maps — the block table rides the scalar-prefetch
+# path next to the ragged lengths, so the index map gathers the LIVE
+# physical block for (batch, logical-block) and clamps past the last
+# live one exactly like the contiguous kernel. Per-step HBM traffic
+# therefore scales with ceil(live_len/bs) blocks per request, never
+# with max_seq_len, and never with the arena size.
+
+def _paged_decode_attn_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                              m_scr, l_scr, acc_scr, *, scale, bs, nb, s):
+    """Grid (b, h, nb); nb = logical blocks per request (sequential
+    accumulator dim). len_ref is the [b] live-length vector (index + s
+    per batch, like the contiguous kernel); bt_ref [b, nb] maps logical
+    to physical arena blocks (consumed by the index maps — unused here
+    beyond documentation: logical col ids already encode causality)."""
+    ib, ik = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[ib]                       # live cols for the LAST row
+    index = length - np.int32(s)               # cache fill before the chunk
+    last = jnp.minimum(
+        jnp.maximum(length - np.int32(1), np.int32(0)) // np.int32(bs),
+        np.int32(nb - 1))                      # last live logical block
+
+    @pl.when(ik <= last)
+    def _compute():
+        q = q_ref[0, 0]                        # [s, d]
+        k = k_ref[0, 0]                        # [bs, d]
+        sc = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        row = jax.lax.broadcasted_iota(jnp.int32, (s, bs), 0)
+        col = ik * bs + jax.lax.broadcasted_iota(jnp.int32, (s, bs), 1)
+        sc = jnp.where(col <= index + row, sc, np.float32(NEG_INF))
+        m_prev = m_scr[:]                      # [s, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(sc - m_new)                # [s, bs] f32
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0, 0],
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+
+    @pl.when(ik == nb - 1)
+    def _flush():
+        denom = jnp.maximum(l_scr[:], 1e-30)   # padded rows stay finite
+        o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def paged_supported(q_shape, arena_shape) -> bool:
+    """Static predicate: can the paged kernel serve q [b, h, s, d] over
+    an arena [n_blocks, h, block_size, d]? block_size is fixed by the
+    pool layout, so it must already be a sublane-tile multiple."""
+    if len(q_shape) != 4 or len(arena_shape) != 4:
+        return False
+    b, h, s, d = q_shape
+    nb_phys, hl, bs, dl = arena_shape
+    if (hl, dl) != (h, d):
+        return False
+    if d > 256 or s < 1 or s > 256:
+        return False
+    return bs >= 8 and bs % 8 == 0 and bs <= 1024 and nb_phys >= 1
+
+
+def _paged_call(q, k_arena, v_arena, block_tables, lengths, scale):
+    """The pallas_call for already-tile-padded q. The arena is NOT
+    padded or copied — indirection is the whole point."""
+    from jax.experimental.pallas import tpu as pltpu
+    b, h, s_p, d = q.shape
+    bs = k_arena.shape[2]
+    nb = block_tables.shape[1]
+
+    def q_map(ib, ih, ik, len_ref, bt_ref):
+        return (ib, ih, 0, 0)
+
+    def kv_map(ib, ih, ik, len_ref, bt_ref):
+        # gather ONLY live physical blocks: past the last live logical
+        # block the index clamps, the physical id repeats, and Pallas
+        # skips the HBM->VMEM DMA for the revisited block — per-step KV
+        # bytes scale with live blocks, not arena/max_seq_len
+        # (np.int32 scalars: see _decode_attn_kernel)
+        last = jnp.minimum(
+            jnp.maximum(len_ref[ib] - np.int32(1),
+                        np.int32(0)) // np.int32(bs),
+            np.int32(nb - 1))
+        return (bt_ref[ib, jnp.minimum(ik, last)], ih, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, nb),
+        in_specs=[
+            pl.BlockSpec((1, 1, s_p, d), q_map),
+            pl.BlockSpec((1, 1, bs, d), kv_map),
+            pl.BlockSpec((1, 1, bs, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, s_p, d), q_map),
+        scratch_shapes=[
+            _vmem((s_p, 1), jnp.float32),
+            _vmem((s_p, 1), jnp.float32),
+            _vmem((s_p, d), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_paged_decode_attn_kernel,
+                               scale=float(scale), bs=bs, nb=nb, s=s_p)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, s_p, d), q.dtype),
+        compiler_params=_cparams("parallel", "parallel", "arbitrary"),
+        interpret=_interpret(),
+    )(lengths, block_tables, q, k_arena, v_arena)
+
+
+def paged_decode_attention(q, k_arena, v_arena, block_tables, lengths,
+                           scale=None):
+    """Attention of q [b, h, s, d] over a PAGED cache: per-request block
+    tables [b, max_blocks] of physical block ids into shared arenas
+    k_arena/v_arena [n_blocks, h, block_size, d]. `lengths` [b] is each
+    request's cache fill count BEFORE this chunk (the chunk's k/v must
+    already be scattered into the arena — nn/kv_pool.write_kv). Row r of
+    batch i attends to logical cache cols <= lengths[i] + r. Block-table
+    entries past the allocation MUST be 0 (the pool's reserved trash
+    block): padded query rows reach past the live end and the index map
+    must land on a valid physical row. Eval-only (no vjp); returns
+    [b, h, s, d] in q's dtype."""
+    b, h, s, d = q.shape
+    if v_arena.shape != k_arena.shape or k_arena.shape[3] != d \
+            or k_arena.shape[1] != h:
+        raise ValueError(
+            f"paged_decode_attention: arena shapes k{tuple(k_arena.shape)} "
+            f"v{tuple(v_arena.shape)} don't match q{tuple(q.shape)}")
+    bs = k_arena.shape[2]
+    if bs % 8 != 0 or bs < 8:
+        raise ValueError(
+            f"paged_decode_attention: block_size {bs} must be a multiple "
+            "of the 8-row sublane tile")
+    if scale is None:
+        scale = d ** -0.5
+    out_dtype = q.dtype
+    if q.dtype != k_arena.dtype:
+        q = q.astype(k_arena.dtype)
+
+    s_p = _ceil_to(s, 8)   # sublane tile: pad query rows, slice back below
+    if s_p != s:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, s_p - s), (0, 0)))
+    # lengths in PADDED-row terms (kernel recovers fill as length - s_p);
+    # padded rows attend a few cols past the live end — garbage rows
+    # sliced off below, and their block-table lookups land on entry 0
+    # (the trash block) by the pool's table convention
+    lens = jnp.asarray(lengths, jnp.int32)
+    lens = jnp.broadcast_to(lens.reshape(-1), (b,)) + jnp.int32(s_p)
+    bt = jnp.asarray(block_tables, jnp.int32)
+    out = _paged_call(q, k_arena, v_arena, bt, lens, scale)
+    out = out.astype(out_dtype)
+    return out[:, :, :s] if s_p != s else out
 
 
 def decode_attention(q, kc, vc, index, scale=None, block_k=None):

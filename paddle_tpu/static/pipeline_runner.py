@@ -55,7 +55,8 @@ import numpy as np
 
 from ..core import trace as _trace
 
-__all__ = ["PipelineRunner", "FetchHandle", "PipelineStepError"]
+__all__ = ["PipelineRunner", "FetchHandle", "PipelineStepError",
+           "InflightDriver"]
 
 # Flow-id namespace: each runner gets a disjoint block so step flows from
 # two runners in one process can't alias in the Chrome trace. Step idx
@@ -159,7 +160,153 @@ class _Inflight:
         self.fetches = fetches
 
 
-class PipelineRunner:
+class _InflightWindow:
+    """The shared in-flight window machinery: bounded retire, in-order
+    verification, first-failure recording. PipelineRunner and
+    InflightDriver both extend it, so failure-ordering/retire semantics
+    cannot drift between the training and serving pipelines. Subclasses
+    provide `_window`, `_failure`, `_flow_base`, `_trace_ctx` and the
+    `_retire_span` name."""
+
+    _retire_span = "pipeline/retire"
+
+    def _record_failure(self, first, last, exc):
+        if self._failure is None:
+            self._failure = (first, last, exc)
+
+    def _retire_over(self, depth):
+        """Bound the in-flight window: block (in submission order) on the
+        oldest steps past `depth`. A step that fails here is recorded and
+        surfaces at the next materialization boundary."""
+        while len(self._window) > depth:
+            e = self._window.popleft()
+            if not e.fetches:
+                continue  # nothing observable; sync() verifies the carry
+            sp = _trace.begin(self._retire_span, step_first=e.first,
+                              step_last=e.last,
+                              parent=self._trace_ctx)
+            for i in range(e.first, e.last + 1):
+                sp.flow(self._flow_base + i, "t")
+            try:
+                jax.block_until_ready(e.fetches)
+            except Exception as exc:
+                sp.attrs["error"] = type(exc).__name__
+                _trace.end(sp)
+                self._record_failure(e.first, e.last, exc)
+                return
+            _trace.end(sp)
+
+    def _verify_through(self, index):
+        """Materialization boundary: verify (in order) every in-flight
+        step up to and including `index`; raise the first failure with
+        its step index."""
+        while self._window and self._window[0].first <= index:
+            e = self._window.popleft()
+            if not e.fetches:
+                continue
+            sp = _trace.begin(self._retire_span, step_first=e.first,
+                              step_last=e.last, boundary=True,
+                              parent=self._trace_ctx)
+            for i in range(e.first, e.last + 1):
+                sp.flow(self._flow_base + i, "t")
+            try:
+                jax.block_until_ready(e.fetches)
+            except Exception as exc:
+                sp.attrs["error"] = type(exc).__name__
+                _trace.end(sp)
+                self._record_failure(e.first, e.last, exc)
+                break
+            _trace.end(sp)
+        # steps BEFORE the failure still materialize normally; the
+        # failure surfaces for any step at-or-after its index
+        if self._failure is not None and self._failure[0] <= index:
+            first, last, exc = self._failure
+            raise PipelineStepError(first, exc, last)
+
+
+class InflightDriver(_InflightWindow):
+    """The PipelineRunner's in-flight window machinery, factored for
+    drivers that are not static Programs — the continuous-batching serve
+    loop (inference/serving.py) dispatches its fused decode steps
+    through one of these so dispatch of step N+1 overlaps
+    sampling/detokenization of step N, with the same semantics the
+    training pipeline proved out:
+
+    - `submit(thunk)` dispatches non-blocking jax work; the thunk
+      returns (carry, fetches) — the carry comes back raw (device
+      arrays the next submit consumes), each fetch leaf comes back as a
+      lazy `FetchHandle`;
+    - the window is bounded at `max_inflight` by blocking on the oldest
+      step (device wait, not host work);
+    - a failed step is recorded and surfaces as `PipelineStepError`
+      (naming the step index, flight-recorder dump attached) at the
+      NEXT materialization — steps before it still materialize;
+    - every dispatch/retire leaves spans with per-step flow chains, so
+      a serve run renders in obs_report/Chrome-trace exactly like a
+      training run, and pulses the elastic liveness listeners.
+
+    span names are `{name}/dispatch` and `{name}/retire_wait`; pass
+    name="serve/decode_step" style prefixes to namespace them."""
+
+    def __init__(self, name="driver", max_inflight=None):
+        from ..core import flags as _flags
+        self._name = name
+        self._retire_span = f"{name}/retire_wait"
+        if max_inflight is None:
+            max_inflight = _flags.flag("FLAGS_executor_max_inflight")
+        self._max_inflight = max(1, int(max_inflight))
+        self._window: deque = deque()
+        self._next_index = 0
+        self._failure = None
+        self._depth_peak = 0
+        self._flow_base = next(_FLOW_NS) << 42
+        self._trace_ctx = _trace.current() or (_trace.new_trace_id(),
+                                               None)
+        from ..distributed.elastic import notify_step
+        self._notify_step = notify_step
+
+    @property
+    def inflight_depth_peak(self):
+        return self._depth_peak
+
+    def submit(self, thunk, **attrs):
+        """Dispatch thunk() -> (carry, fetches). Returns (carry,
+        handles); carry is None when the dispatch itself failed (the
+        failure surfaces at the handles' materialization)."""
+        if self._failure is not None:
+            idx = self._next_index
+            self._next_index += 1
+            return None, [FetchHandle(None, idx, self)]
+        sp = _trace.begin(f"{self._name}/dispatch",
+                          parent=self._trace_ctx, **attrs)
+        idx = self._next_index
+        self._next_index += 1
+        sp.attrs["step"] = idx
+        sp.flow(self._flow_base + idx, "s")
+        try:
+            try:
+                carry, fetches = thunk()
+            except Exception as exc:
+                sp.attrs["error"] = type(exc).__name__
+                self._record_failure(idx, idx, exc)
+                return None, [FetchHandle(None, idx, self)]
+        finally:
+            _trace.end(sp)
+        if not isinstance(fetches, (tuple, list)):
+            fetches = [fetches]
+        self._window.append(_Inflight(idx, idx, list(fetches)))
+        self._retire_over(self._max_inflight)
+        self._depth_peak = max(self._depth_peak, len(self._window))
+        self._notify_step(idx + 1)
+        return carry, [FetchHandle(f, idx, self) for f in fetches]
+
+    def sync(self):
+        """Materialize ALL in-flight work; raises PipelineStepError
+        naming the first failed step, if any."""
+        self._verify_through(self._next_index)
+
+
+class PipelineRunner(_InflightWindow):
     """Drives a static Program's compiled step with in-flight steps and a
     device-resident carry. Use as a context manager; `sync()` (or exit)
     materializes all in-flight work and writes the Scope/slots back."""
@@ -245,10 +392,6 @@ class PipelineRunner:
             return {n: entry.opt._slots[n] for n in entry.opt_pnames}
         return prev_slots
 
-    def _record_failure(self, first, last, exc):
-        if self._failure is None:
-            self._failure = (first, last, exc)
-
     def _dead_handles(self, k=1):
         entry = self._entry
         n_fetch = len(entry.fetch_ids) if entry is not None else 0
@@ -260,54 +403,7 @@ class PipelineRunner:
                         for _ in range(n_fetch)])
         return out
 
-    def _retire_over(self, depth):
-        """Bound the in-flight window: block (in submission order) on the
-        oldest steps past `depth`. A step that fails here is recorded and
-        surfaces at the next materialization boundary."""
-        while len(self._window) > depth:
-            e = self._window.popleft()
-            if not e.fetches:
-                continue  # nothing observable; sync() verifies the carry
-            sp = _trace.begin("pipeline/retire", step_first=e.first,
-                              step_last=e.last,
-                              parent=self._trace_ctx)
-            for i in range(e.first, e.last + 1):
-                sp.flow(self._flow_base + i, "t")
-            try:
-                jax.block_until_ready(e.fetches)
-            except Exception as exc:
-                sp.attrs["error"] = type(exc).__name__
-                _trace.end(sp)
-                self._record_failure(e.first, e.last, exc)
-                return
-            _trace.end(sp)
-
-    def _verify_through(self, index):
-        """Materialization boundary: verify (in order) every in-flight
-        step up to and including `index`; raise the first failure with
-        its step index."""
-        while self._window and self._window[0].first <= index:
-            e = self._window.popleft()
-            if not e.fetches:
-                continue
-            sp = _trace.begin("pipeline/retire", step_first=e.first,
-                              step_last=e.last, boundary=True,
-                              parent=self._trace_ctx)
-            for i in range(e.first, e.last + 1):
-                sp.flow(self._flow_base + i, "t")
-            try:
-                jax.block_until_ready(e.fetches)
-            except Exception as exc:
-                sp.attrs["error"] = type(exc).__name__
-                _trace.end(sp)
-                self._record_failure(e.first, e.last, exc)
-                break
-            _trace.end(sp)
-        # steps BEFORE the failure still materialize normally; the
-        # failure surfaces for any step at-or-after its index
-        if self._failure is not None and self._failure[0] <= index:
-            first, last, exc = self._failure
-            raise PipelineStepError(first, exc, last)
+    # _record_failure/_retire_over/_verify_through: _InflightWindow
 
     # -- submission ----------------------------------------------------------
     def submit(self, feed):

@@ -119,6 +119,48 @@ class GPT(nn.Layer):
         logits = ops.matmul(x[:, -1], self.wte.weight, transpose_y=True)
         return logits._value, new_caches
 
+    def _forward_paged(self, input_ids, caches, last_index=None):
+        """One paged decode/prefill pass over the serving tier's shared
+        block arena (nn/kv_pool.py). input_ids [b, s] (Tensor or jnp);
+        caches: list of PagedKVCache (one per block) whose `lengths`
+        field carries each slot's fill count — per-slot positions, not
+        the scalar index of `_forward_cached`. `last_index` [b] (or
+        None = s-1) picks the position whose logits come back: a
+        bucket-padded prefill reads the logits at the REAL last prompt
+        token, not the pad tail. Returns (logits [b, V] jnp, new
+        caches)."""
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(input_ids, _internal=True)
+        s = ids.shape[1]
+        lens = jnp.asarray(caches[0].lengths, jnp.int32)
+        pos = lens[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        # pad rows of a bucketed prefill can run past the cap; their
+        # k/v writes already land in the trash block, so the position
+        # embedding only needs to stay in range
+        pos = jnp.clip(pos, 0, self.config.max_seq_len - 1)
+        x = self.wte(ids) + self.wpe(Tensor(pos, _internal=True))
+        x = self.drop(x)
+        new_caches = []
+        for blk, c in zip(self.blocks, caches):
+            x, c = blk(x, cache=c)
+            new_caches.append(c)
+        x = self.ln_f(x)
+        h = x._value
+        if last_index is not None:
+            idx = jnp.asarray(last_index, jnp.int32).reshape(-1)
+            h = jnp.take_along_axis(
+                h, idx[:, None, None].astype(jnp.int32),
+                axis=1)[:, 0]
+        else:
+            h = h[:, -1]
+        logits = ops.matmul(Tensor(h, _internal=True), self.wte.weight,
+                            transpose_y=True)
+        return logits._value, new_caches
+
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
                  top_k=None, eos_token_id=None, use_cache=True, seed=0):
         """Autoregressive sampling (reference generation utils; greedy at
@@ -270,10 +312,29 @@ def _build_decode_fn(net, max_new, temperature, top_k, eos_id, total,
                 caches, logits, finished, index = carry
                 nxt = sample(logits, step_key)
                 if eos_id is not None:
+                    # finished rows are frozen to eos (their sample is
+                    # discarded), and once EVERY row is finished the
+                    # whole forward is skipped: the scan still runs to
+                    # max_new for shape stability, but the tail steps
+                    # cost one all-reduce of `finished`, not a model
+                    # pass — per-request EOS at batched-decode cost
                     nxt = jnp.where(finished, jnp.int32(eos_id), nxt)
                     finished = finished | (nxt == eos_id)
-                logits, caches = net._forward_cached(nxt[:, None], caches,
-                                                     index)
+
+                    def _run(op):
+                        c, _lg, nx, ix = op
+                        return net._forward_cached(nx[:, None], c, ix)
+
+                    def _skip(op):
+                        c, lg, _nx, _ix = op
+                        return lg, c
+
+                    logits, caches = jax.lax.cond(
+                        jnp.all(finished), _skip, _run,
+                        (caches, logits, nxt, index))
+                else:
+                    logits, caches = net._forward_cached(nxt[:, None],
+                                                         caches, index)
                 return (caches, logits, finished, index + 1), nxt
 
             init = (caches, logits, jnp.zeros((b,), bool), jnp.int32(s))

@@ -360,7 +360,8 @@ def check_concretization(ops_dir=OPS_DIR):
 # gate. Each module lives in tools/, exposes `self_check()` returning a
 # list of violation strings, and `main(argv)` for standalone use.
 TOOL_CROSS_CHECKS = ["spmd_lint", "hlo_evidence", "pipeline_lint",
-                     "obs_report", "ps_load_test", "elastic_drill"]
+                     "obs_report", "ps_load_test", "elastic_drill",
+                     "serve_load_test"]
 
 
 def check_registered_tools():

@@ -72,6 +72,14 @@ TINY_BERT_CFG = {"batch": 2, "seq": 16, "dtype": "float32"}
 TINY_DECODE_CFG = {"batch": 2, "prompt": 4, "new": 8, "max_seq_len": 64}
 TINY_LONGSEQ_CFG = {"batch": 1, "seq": 128}
 
+# serving-tier fused decode step (inference/serving.py over the paged
+# KV pool): slots/blocks mirror the FLAGS_serve_* defaults and bench.py's
+# BENCH_SERVE_* env defaults (serve_load_test.self_check pins all three)
+SERVE_CFG = {"slots": 64, "blocks": 512, "block_size": 128,
+             "max_seq_len": 1024, "prompt": 32, "new": 64}
+TINY_SERVE_CFG = {"slots": 2, "blocks": 6, "block_size": 16,
+                  "max_seq_len": 64, "prompt": 4, "new": 8}
+
 # kernel function names as they appear in `kernel_name = "..."` in the
 # TPU-lowered StableHLO custom calls
 KERNEL_NAMES = {
@@ -80,6 +88,7 @@ KERNEL_NAMES = {
     "fused_ce": ["_ce_fwd_kernel", "_ce_bwd_dh_kernel",
                  "_ce_bwd_dw_kernel"],
     "decode_attention": ["_decode_attn_kernel"],
+    "paged_decode_attention": ["_paged_decode_attn_kernel"],
 }
 
 _KERNEL_RE = re.compile(r'kernel_name = "([^"]+)"')
@@ -269,6 +278,87 @@ def lower_gpt_decode_step(cfg, use_kernel):
     finally:
         paddle.set_flags({"FLAGS_use_decode_attention": True})
         net.load_functional_state(params, buffers)
+
+
+def lower_serve_decode_step(cfg, use_kernel=True):
+    """ONE fused continuous-batching decode step (inference/serving.py):
+    every active slot advances one token against the shared paged KV
+    arena through the block-table kernel. Lowers the PRODUCTION step
+    builder (serving.build_decode_step), so the evidence cannot drift
+    from the serve loop. Arenas/tables are passed as ShapeDtypeStructs —
+    lowering needs avals, not the multi-GB buffers."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.serving import build_decode_step
+    from paddle_tpu.text.models.gpt import GPT, GPTConfig
+
+    A, bs = cfg["slots"], cfg["block_size"]
+    total = cfg["max_seq_len"]
+    nb = cfg["blocks"]
+    mb = -(-total // bs)
+    gcfg = GPTConfig(max_seq_len=total) if total >= 1024 else \
+        GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2,
+                  num_heads=2, intermediate_size=128, max_seq_len=total)
+    gcfg.dropout = 0.0
+    paddle.seed(0)
+    net = GPT(gcfg)
+    net.eval()
+    params, buffers = net.functional_state()
+    heads = gcfg.num_heads
+    hd = gcfg.hidden_size // heads
+    arena = jax.ShapeDtypeStruct((nb + 1, heads, bs, hd), jnp.float32)
+    arenas = [(arena, arena) for _ in range(gcfg.num_layers)]
+    bt = jax.ShapeDtypeStruct((A, mb), jnp.int32)
+    lens = jax.ShapeDtypeStruct((A,), jnp.int32)
+    toks = jax.ShapeDtypeStruct((A,), jnp.int32)
+    keys = jax.ShapeDtypeStruct((A, 2), jnp.uint32)
+    step = build_decode_step(net, temperature=0.0, top_k=None)
+    paddle.set_flags({"FLAGS_use_paged_attention": bool(use_kernel)})
+    try:
+        return _lower_tpu(step, params, buffers, arenas, bt, lens, toks,
+                          keys)
+    finally:
+        paddle.set_flags({"FLAGS_use_paged_attention": True})
+        net.load_functional_state(params, buffers)
+
+
+def serve_decode_bytes_model(cfg, heads, head_dim, layers,
+                             dtype_bytes=4):
+    """Per-step attention KV-read accounting for the PAGED kernel: the
+    clamped block-table index map DMAs ceil(live/bs) physical blocks per
+    slot, so per-step KV bytes are a function of each request's LIVE
+    length — the full-cache jnp path (and a StaticKVCache sized to
+    max_seq_len) streams max_seq_len columns per slot regardless. Stated
+    at several fill levels to show the scaling law, plus the reduction
+    at the serve config's typical fill (prompt + new/2)."""
+    A, bs, L = cfg["slots"], cfg["block_size"], cfg["max_seq_len"]
+    nb_req = -(-L // bs)
+
+    def kv_bytes(cols):
+        return 2.0 * A * heads * cols * head_dim * dtype_bytes * layers
+
+    fills = sorted({1, max(nb_req // 4, 1), max(nb_req // 2, 1), nb_req})
+    scaling = [{"live_blocks": n, "live_cols": n * bs,
+                "kv_bytes_per_step": kv_bytes(n * bs)} for n in fills]
+    typical = min(cfg["prompt"] + cfg["new"] // 2, L)
+    typ_cols = min(-(-typical // bs), nb_req) * bs
+    return {
+        "model": "per-step KV reads: paged kernel = ceil(live/bs)*bs "
+                 "cols per slot (clamped block-table index map skips "
+                 "dead-block DMA); full-cache path = max_seq_len cols "
+                 "per slot at any fill",
+        "block_size": bs,
+        "slots": A,
+        "bytes_by_live_blocks": scaling,
+        "full_cache_bytes_per_step": kv_bytes(L),
+        "typical_fill_tokens": typical,
+        "typical_live_cols": typ_cols,
+        "typical_kv_bytes_per_step": kv_bytes(typ_cols),
+        "bytes_reduction_x_at_typical_fill":
+            round(kv_bytes(L) / kv_bytes(typ_cols), 2),
+    }
 
 
 def lower_pipeline_scan(cfg):
@@ -490,7 +580,43 @@ def run(out_path="HLO_EVIDENCE.json", tiny=False):
               full["bytes_reduction_x"] >= 2.0,
               f"{full['bytes_reduction_x']}x")
 
+        # ---- serving: fused continuous-batching paged decode step -----
+        scfg = TINY_SERVE_CFG if tiny else SERVE_CFG
+        _reset_counters()
+        srv = record("serve_decode",
+                     _with_big_stack(
+                         lambda: lower_serve_decode_step(scfg)),
+                     scfg)
+        pkn = KERNEL_NAMES["paged_decode_attention"][0]
+        check(f"serve_decode has {pkn}",
+              srv["custom_calls"].get(pkn, 0) > 0)
+        s_heads = 12 if not tiny else 2
+        s_hd = 64 if not tiny else 32
+        s_layers = 12 if not tiny else 2
+        srv["kv_bytes_per_step"] = serve_decode_bytes_model(
+            scfg, s_heads, s_hd, s_layers)
+        # the scaling bar is about the DEFAULT serve config; its model is
+        # pure arithmetic, so evaluate it even in --tiny
+        full_srv = srv["kv_bytes_per_step"] if not tiny else \
+            serve_decode_bytes_model(SERVE_CFG, 12, 64, 12)
+        if tiny:
+            srv["kv_bytes_per_step_full_config"] = full_srv
+        sc = full_srv["bytes_by_live_blocks"]
+        linear = all(
+            abs(e["kv_bytes_per_step"]
+                - sc[0]["kv_bytes_per_step"] * e["live_blocks"]) < 1e-6
+            for e in sc)
+        check("serve decode per-step KV bytes scale with live blocks "
+              "(default serve cfg)", linear,
+              f"{[e['live_blocks'] for e in sc]} blocks -> "
+              f"{[e['kv_bytes_per_step'] for e in sc]} bytes")
+        check("serve decode KV bytes reduced >= 2x vs max_seq_len at "
+              "typical fill (default serve cfg)",
+              full_srv["bytes_reduction_x_at_typical_fill"] >= 2.0,
+              f"{full_srv['bytes_reduction_x_at_typical_fill']}x")
+
         # ---- scan-fused executor megastep (async pipelined hot loop) --
+        _reset_counters()  # the serve lowering's hits are not this graph's
         pcfg = TINY_PIPELINE_CFG if tiny else PIPELINE_CFG
         lowered, info = _with_big_stack(
             lambda: lower_pipeline_scan(pcfg))
@@ -563,6 +689,10 @@ def self_check():
     bench_default("BENCH_DECODE_PROMPT", DECODE_CFG["prompt"])
     bench_default("BENCH_DECODE_NEW", DECODE_CFG["new"])
     bench_default("BENCH_LONGSEQ", LONGSEQ_CFG["seq"])
+    bench_default("BENCH_SERVE_SLOTS", SERVE_CFG["slots"])
+    bench_default("BENCH_SERVE_BLOCKS", SERVE_CFG["blocks"])
+    bench_default("BENCH_SERVE_PROMPT", SERVE_CFG["prompt"])
+    bench_default("BENCH_SERVE_NEW", SERVE_CFG["new"])
     if f"max_seq_len={DECODE_CFG['max_seq_len']}" not in src:
         problems.append(
             "hlo_evidence: bench.py decode config no longer uses "
@@ -595,6 +725,11 @@ def self_check():
     if not da.supported((b, 12, 1, 64), (b, 12, L, 64)):
         problems.append("hlo_evidence: decode gate rejects the decode "
                         f"bench shape (b={b}, L={L})")
+    sA, sbs, snb = SERVE_CFG["slots"], SERVE_CFG["block_size"], \
+        SERVE_CFG["blocks"]
+    if not da.paged_supported((sA, 12, 1, 64), (snb + 1, 12, sbs, 64)):
+        problems.append("hlo_evidence: paged-decode gate rejects the "
+                        f"serve config (slots={sA}, bs={sbs})")
     n_tok_gpt = LONGSEQ_CFG["batch"] * s
     if not fc.supported(n_tok_gpt, 768, 50304):
         problems.append("hlo_evidence: fused_ce gate rejects the GPT "

@@ -179,6 +179,43 @@ def pallas_rates(metrics) -> str:
          "detail"], rows)
 
 
+# gauge/counter names the serving section renders; self_check pins them
+# against inference/serving.py GAUGES/COUNTERS so the two cannot drift
+SERVE_GAUGES = ("serve.queue_depth", "serve.active_slots",
+                "serve.kv_pool_used_blocks", "serve.kv_pool_free_blocks")
+SERVE_COUNTERS = ("serve.preempted", "serve.tokens_generated",
+                  "serve.requests_completed", "serve.requests_errored")
+_SERVE_SPANS = ("serve/admit", "serve/prefill", "serve/decode_step",
+                "serve/retire", "serve/evict")
+
+
+def serving_section(metrics, spans) -> str:
+    """Continuous-batching serve tier: pool/queue gauges, stream
+    counters, TTFT/per-token latency histograms, and the per-phase span
+    table (admit/prefill/decode_step/retire/evict)."""
+    values = metrics.get("values", {})
+    rows = [[k, values[k]] for k in SERVE_GAUGES + SERVE_COUNTERS
+            if k in values]
+    out = [_fmt_table(["metric", "value"], rows)]
+    for hname, label in (("serve/ttft_ms", "ttft"),
+                         ("serve/token_ms", "per-token")):
+        h = metrics.get("histograms", {}).get(hname)
+        if h:
+            out.append(f"  {label}: n={h['count']} avg={h['avg']:.3f}ms "
+                       f"min={h['min']:.3f}ms max={h['max']:.3f}ms")
+    agg = defaultdict(lambda: [0, 0.0])
+    for sp in spans:
+        if sp.get("name") in _SERVE_SPANS:
+            a = agg[sp["name"]]
+            a[0] += 1
+            a[1] += sp.get("dur_us", 0) / 1e3
+    if agg:
+        out.append(_fmt_table(
+            ["phase", "calls", "total_ms"],
+            [[n, c, f"{t:.3f}"] for n, (c, t) in sorted(agg.items())]))
+    return "\n".join(out)
+
+
 def render(dump: dict) -> str:
     out = []
     exc = dump.get("exception")
@@ -200,6 +237,8 @@ def render(dump: dict) -> str:
     out.append(ps_health(metrics))
     out.append("\n== pallas kernels ==")
     out.append(pallas_rates(metrics))
+    out.append("\n== serving ==")
+    out.append(serving_section(metrics, spans))
     return "\n".join(out)
 
 
@@ -246,6 +285,20 @@ def self_check():
             problems.append(
                 f"obs_report: flag {name} default {defs[name][1]} != "
                 f"OBS_CFG {want} — update the canonical config")
+    # serving section <-> the serve loop's published names
+    try:
+        from paddle_tpu.inference import serving
+        if tuple(serving.GAUGES) != SERVE_GAUGES:
+            problems.append(
+                f"obs_report: serving.GAUGES {serving.GAUGES} != "
+                f"renderer SERVE_GAUGES {SERVE_GAUGES} — update both")
+        if tuple(serving.COUNTERS) != SERVE_COUNTERS:
+            problems.append(
+                f"obs_report: serving.COUNTERS {serving.COUNTERS} != "
+                f"renderer SERVE_COUNTERS {SERVE_COUNTERS}")
+    except Exception as e:
+        problems.append(
+            f"obs_report: cannot cross-check serving gauges: {e!r}")
     # monitor export surface the dump format relies on
     for fn in ("snapshot", "export_jsonl", "prometheus_text", "observe"):
         if not callable(getattr(monitor, fn, None)):

@@ -1,0 +1,285 @@
+"""Continuous-batching serve-tier load test (the ROADMAP "hundreds of
+concurrent generate streams" proof).
+
+Spins up a ServeLoop (inference/serving.py) over a tiny GPT and drives
+SERVE_LOAD_STREAMS concurrent generate streams from SERVE_LOAD_CLIENTS
+client threads with jittered arrivals — far more streams than decode
+slots, so the run exercises admission scheduling, pool backpressure and
+retire-then-admit churn, not just the fused decode step. Reports
+tokens/s, p50/p99 time-to-first-token and p50/p99 per-token latency, the
+serve.* gauge snapshot, and FAILS (exit 1) on any request error. With
+SERVE_LOAD_VERIFY=N, N randomly chosen streams are cross-checked
+token-for-token against per-request sequential `GPT.generate` — the
+continuous-batching correctness oracle running inside the load test
+itself.
+
+Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python tools/serve_load_test.py
+
+Env knobs (defaults are the CPU-valid tier-1 shape):
+  SERVE_LOAD_STREAMS=256   concurrent generate streams
+  SERVE_LOAD_CLIENTS=32    client threads submitting them
+  SERVE_LOAD_PROMPT=12     max prompt length (ragged 4..PROMPT)
+  SERVE_LOAD_NEW=16        tokens generated per stream
+  SERVE_LOAD_SLOTS=64      decode slots (ServeConfig.max_active)
+  SERVE_LOAD_BLOCKS=160    KV pool blocks
+  SERVE_LOAD_BLOCK_SIZE=16 tokens per pool block
+  SERVE_LOAD_VERIFY=4      streams cross-checked vs sequential generate
+
+framework_lint TOOL_CROSS_CHECKS runs self_check() here: the
+FLAGS_serve_* defaults, bench.py's BENCH_SERVE_* serve-mode knobs,
+tools/hlo_evidence.py's SERVE_CFG, and docs/serving.md must agree.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+import numpy as np  # noqa: E402
+
+STREAMS = int(os.environ.get("SERVE_LOAD_STREAMS", 256))
+CLIENTS = int(os.environ.get("SERVE_LOAD_CLIENTS", 32))
+PROMPT = int(os.environ.get("SERVE_LOAD_PROMPT", 12))
+NEW = int(os.environ.get("SERVE_LOAD_NEW", 16))
+SLOTS = int(os.environ.get("SERVE_LOAD_SLOTS", 64))
+BLOCKS = int(os.environ.get("SERVE_LOAD_BLOCKS", 160))
+BLOCK_SIZE = int(os.environ.get("SERVE_LOAD_BLOCK_SIZE", 16))
+VERIFY = int(os.environ.get("SERVE_LOAD_VERIFY", 4))
+
+# flag defaults this tool (and docs/serving.md's flag table) are written
+# against; drift means the doc + this header need an update
+SERVE_FLAG_DEFAULTS = {
+    "FLAGS_use_paged_attention": True,
+    "FLAGS_serve_block_size": 0,
+    "FLAGS_serve_kv_blocks": 512,
+    "FLAGS_serve_max_active": 64,
+}
+
+# bench.py serve-mode env defaults (BENCH_MODE=serve); self_check pins
+# them so the bench line and this drill describe the same tier
+BENCH_SERVE_DEFAULTS = {
+    "BENCH_SERVE_REQUESTS": 256,
+    "BENCH_SERVE_PROMPT": 32,
+    "BENCH_SERVE_NEW": 64,
+    "BENCH_SERVE_SLOTS": 64,
+    "BENCH_SERVE_BLOCKS": 512,
+}
+
+
+def run():
+    import paddle_tpu as paddle
+    from paddle_tpu.core import monitor
+    from paddle_tpu.inference import ServeConfig, ServeLoop
+    from paddle_tpu.text.models.gpt import GPT, GPTConfig
+
+    paddle.seed(0)
+    cfg = GPTConfig.tiny()
+    net = GPT(cfg)
+    net.eval()
+    cap = min(cfg.max_seq_len, PROMPT + NEW + BLOCK_SIZE)
+    loop = ServeLoop(net, ServeConfig(max_active=SLOTS, kv_blocks=BLOCKS,
+                                      block_size=BLOCK_SIZE,
+                                      max_seq_len=cap))
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg.vocab_size,
+                           (int(rng.randint(4, PROMPT + 1)),))
+               .astype(np.int64) for _ in range(STREAMS)]
+
+    # compile outside the timed window: the decode step plus ONE prefill
+    # per bucket the ragged prompts can land in (a cold bucket would put
+    # an XLA compile inside the timed p99)
+    buckets = {}
+    for p in prompts:
+        b = 8
+        while b < p.size:
+            b *= 2
+        buckets.setdefault(b, p)
+    for p in buckets.values():
+        loop.serve([p], max_new_tokens=2)
+    monitor.reset(prefix="serve.")
+    loop.start()
+
+    reqs = [None] * STREAMS
+    errors = []
+    lock = threading.Lock()
+
+    def client(cid):
+        crng = np.random.RandomState(1000 + cid)
+        for i in range(cid, STREAMS, CLIENTS):
+            time.sleep(float(crng.uniform(0, 0.002)))  # jittered arrival
+            try:
+                reqs[i] = loop.submit(prompts[i], max_new_tokens=NEW)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"submit[{i}]: {type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    ths = [threading.Thread(target=client, args=(c,))
+           for c in range(CLIENTS)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    outs = [None] * STREAMS
+    toks = 0
+    ttfts, per_tok = [], []
+    for i, r in enumerate(reqs):
+        if r is None:
+            continue
+        try:
+            outs[i] = r.result(timeout=600)
+            toks += len(outs[i])
+            if r.ttft_s is not None:
+                ttfts.append(r.ttft_s * 1e3)
+            if r.per_token_s is not None:
+                per_tok.append(r.per_token_s * 1e3)
+        except Exception as e:  # noqa: BLE001
+            errors.append(f"result[{i}]: {type(e).__name__}: {e}")
+    dt = time.perf_counter() - t0
+    loop.stop()
+
+    verified = 0
+    if VERIFY:
+        idxs = np.random.RandomState(7).choice(
+            STREAMS, size=min(VERIFY, STREAMS), replace=False)
+        for i in sorted(int(x) for x in idxs):
+            if outs[i] is None:
+                continue
+            ref = np.asarray(net.generate(
+                paddle.to_tensor(prompts[i][None]), max_new_tokens=NEW,
+                temperature=0, use_cache=True).numpy())[0,
+                                                        prompts[i].size:]
+            if not np.array_equal(outs[i], ref):
+                errors.append(
+                    f"verify[{i}]: serve tokens != sequential generate "
+                    f"({outs[i].tolist()} vs {ref.tolist()})")
+            else:
+                verified += 1
+
+    def pct(xs, p):
+        return round(float(np.percentile(xs, p)), 3) if xs else None
+
+    snap = {k: v for k, v in monitor.stats("serve.").items()}
+    report = {
+        "tool": "tools/serve_load_test.py",
+        "streams": STREAMS,
+        "clients": CLIENTS,
+        "slots": SLOTS,
+        "kv_blocks": BLOCKS,
+        "block_size": BLOCK_SIZE,
+        "tokens": toks,
+        "tokens_per_s": round(toks / dt, 2),
+        "wall_s": round(dt, 3),
+        "ttft_ms": {"p50": pct(ttfts, 50), "p99": pct(ttfts, 99)},
+        "token_ms": {"p50": pct(per_tok, 50), "p99": pct(per_tok, 99)},
+        "preempted": int(snap.get("serve.preempted", 0)),
+        "completed": int(snap.get("serve.requests_completed", 0)),
+        "verified_vs_generate": verified,
+        "request_errors": len(errors),
+    }
+    print(json.dumps(report, indent=1))
+    for e in errors[:10]:
+        print("ERROR:", e, file=sys.stderr)
+    return 1 if errors else 0
+
+
+# --------------------------------------------------------------------------
+# framework_lint cross-check (TOOL_CROSS_CHECKS)
+# --------------------------------------------------------------------------
+
+def self_check():
+    """Serve knobs <-> flag defaults <-> bench serve config <->
+    hlo_evidence serve_decode config <-> docs. Returns violations."""
+    problems = []
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from paddle_tpu.core import flags as _flags
+    except Exception as e:  # pragma: no cover
+        return [f"serve_load_test: paddle_tpu import failed: {e!r}"]
+    for name, want in SERVE_FLAG_DEFAULTS.items():
+        defn = _flags._DEFS.get(name)
+        if defn is None:
+            problems.append(f"serve_load_test: flag {name} is no longer "
+                            "defined in core/flags.py")
+        elif defn[1] != want:
+            problems.append(
+                f"serve_load_test: {name} default drifted "
+                f"({defn[1]!r} != {want!r}) — update SERVE_FLAG_DEFAULTS "
+                "and docs/serving.md")
+    # bench.py serve-mode env defaults
+    import re
+    with open(os.path.join(repo, "bench.py")) as f:
+        src = f.read()
+    for env, want in BENCH_SERVE_DEFAULTS.items():
+        m = re.search(r'os\.environ\.get\("%s",\s*([0-9]+)\)' % env, src)
+        if not m:
+            problems.append(
+                f"serve_load_test: bench.py no longer reads {env}")
+        elif int(m.group(1)) != want:
+            problems.append(
+                f"serve_load_test: bench.py default {env}={m.group(1)} "
+                f"but this tool assumes {want}")
+    # the bench serve slots/blocks defaults must BE the flag defaults —
+    # one serving shape across bench, flags and the evidence tool
+    if BENCH_SERVE_DEFAULTS["BENCH_SERVE_SLOTS"] != \
+            SERVE_FLAG_DEFAULTS["FLAGS_serve_max_active"]:
+        problems.append("serve_load_test: BENCH_SERVE_SLOTS != "
+                        "FLAGS_serve_max_active default")
+    if BENCH_SERVE_DEFAULTS["BENCH_SERVE_BLOCKS"] != \
+            SERVE_FLAG_DEFAULTS["FLAGS_serve_kv_blocks"]:
+        problems.append("serve_load_test: BENCH_SERVE_BLOCKS != "
+                        "FLAGS_serve_kv_blocks default")
+    # hlo_evidence's serve_decode config
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import hlo_evidence
+        scfg = hlo_evidence.SERVE_CFG
+        if scfg["slots"] != SERVE_FLAG_DEFAULTS["FLAGS_serve_max_active"]:
+            problems.append(
+                "serve_load_test: hlo_evidence SERVE_CFG slots "
+                f"{scfg['slots']} != FLAGS_serve_max_active default")
+        if scfg["blocks"] != SERVE_FLAG_DEFAULTS["FLAGS_serve_kv_blocks"]:
+            problems.append(
+                "serve_load_test: hlo_evidence SERVE_CFG blocks "
+                f"{scfg['blocks']} != FLAGS_serve_kv_blocks default")
+    except Exception as e:  # pragma: no cover
+        problems.append(
+            f"serve_load_test: cannot cross-check hlo_evidence: {e!r}")
+    # docs
+    doc_path = os.path.join(repo, "docs", "serving.md")
+    try:
+        with open(doc_path) as f:
+            doc = f.read()
+    except OSError as e:
+        return problems + [f"serve_load_test: cannot read {doc_path}: {e}"]
+    for name in SERVE_FLAG_DEFAULTS:
+        if name not in doc:
+            problems.append(f"serve_load_test: flag {name} is not "
+                            "documented in docs/serving.md")
+    for token in ("serve_load_test", "BENCH_MODE=serve"):
+        if token not in doc:
+            problems.append(
+                f"serve_load_test: docs/serving.md no longer mentions "
+                f"`{token}`")
+    return problems
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--self-check" in argv or "--self_check" in argv:
+        problems = self_check()
+        for p in problems:
+            print(p)
+        print("serve_load_test self-check:",
+              "clean" if not problems else f"{len(problems)} problem(s)")
+        return 1 if problems else 0
+    return run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
