@@ -77,6 +77,21 @@ define_flag("FLAGS_log_spmd_estimate", False,
             "publish the spmd.{collective_bytes,hbm_estimate,"
             "resharding_count} monitor gauges (non-strict; set "
             "PADDLE_TPU_VERIFY_SPMD=1 to FAIL compilation on findings)")
+define_flag("FLAGS_spmd_plan_beam", 4,
+            "beam width of the auto-sharding planner's grouped search "
+            "(static/spmd_planner.py). Must be wide enough to carry a "
+            "chain-opening candidate (column-parallel qkv is illegal "
+            "until the row-parallel out-proj closes the chain) past the "
+            "always-legal replicated state")
+define_flag("FLAGS_spmd_plan_sweeps", 1,
+            "coordinate-descent polish passes the planner runs over the "
+            "beam winner (feasible moves only; 0 disables)")
+define_flag("FLAGS_spmd_plan_coll_weight", 1.0,
+            "planner objective weight on predicted collective bytes/step "
+            "(spmd_analyzer pricing)")
+define_flag("FLAGS_spmd_plan_hbm_weight", 1.0,
+            "planner objective weight on predicted peak per-device HBM "
+            "bytes")
 define_flag("FLAGS_use_flash_attention", True,
             "route attention through the Pallas flash kernel on TPU "
             "(paddle_tpu.ops.pallas.flash_attention)")

@@ -373,6 +373,16 @@ class DistributedOptimizer:
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
         strategy = self.user_defined_strategy
+        if getattr(strategy, "auto_shard", False) \
+                and hasattr(loss, "program"):
+            # tag the Program; the Executor's compile path resolves the
+            # plan (static/spmd_planner.resolve_auto_shard) against the
+            # mesh live at compile time, then the VERIFY_SPMD hook and
+            # FLAGS_log_spmd_estimate read the resolved specs
+            from ...static.program import default_main_program
+            program = loss.program or default_main_program()
+            program._auto_shard = dict(
+                getattr(strategy, "auto_shard_configs", None) or {})
         if strategy.recompute and hasattr(loss, "program"):
             # static graph: tag the Program; the Executor lowering splits
             # the op list at these variables and wraps each segment in

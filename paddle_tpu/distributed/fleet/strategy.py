@@ -38,6 +38,14 @@ class DistributedStrategy:
         self.sharding = False
         self.sharding_configs = {"segment_broadcast_MB": 32,
                                  "sharding_degree": 8, "stage": 2}
+        # auto_shard: derive PartitionSpecs with the planner
+        # (static/spmd_planner.py) at compile instead of the hand-written
+        # COLUMN_PARALLEL/ROW_PARALLEL presets. Configs may carry a
+        # pre-searched "plan" (ShardingPlan), a "mesh" ({axis: size}
+        # dict), "names" (scope->dotted), "data_specs", "zero_dp" and the
+        # objective weights; everything defaults from the fleet mesh.
+        self.auto_shard = False
+        self.auto_shard_configs = {}
         self.pipeline = False
         self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1,
                                  "schedule_mode": "1F1B"}

@@ -26,8 +26,8 @@ from . import mesh as mesh_mod
 
 __all__ = ["param_spec_for", "build_param_shardings", "COLUMN_PARALLEL",
            "ROW_PARALLEL", "VOCAB_PARALLEL", "add_tp_rule",
-           "shard_optimizer_state", "group_sharded_parallel",
-           "named_param_specs", "mesh_like"]
+           "remove_tp_rule", "shard_optimizer_state",
+           "group_sharded_parallel", "named_param_specs", "mesh_like"]
 
 COLUMN_PARALLEL = [
     r"qkv_proj\.weight$", r"q_proj\.weight$", r"k_proj\.weight$",
@@ -46,12 +46,39 @@ VOCAB_PARALLEL = [
     r"word_embeddings\.weight$", r"wte\.weight$",
 ]
 
-_extra_rules = []  # (regex, spec_builder(ndim) -> P)
+_extra_rules = []  # (regex, P | spec_builder(ndim) -> P)
 
 
-def add_tp_rule(pattern: str, spec: P):
-    """Register a custom tensor-parallel rule (most-specific wins last)."""
+def add_tp_rule(pattern: str, spec):
+    """Register a custom tensor-parallel rule (most-specific wins last).
+
+    `spec` is either a fixed PartitionSpec or a callable `(ndim) -> P`
+    so one rule can serve params of different ranks (e.g. weight+bias
+    under one name template). Fixed specs are rank-checked when the rule
+    MATCHES — a 2-entry spec on a 1-D param raises here, naming the rule,
+    instead of surfacing as a spec-rank crash deep in the partitioner."""
     _extra_rules.append((re.compile(pattern), spec))
+
+
+def remove_tp_rule(pattern: str) -> int:
+    """Unregister every rule added for `pattern`; returns how many."""
+    before = len(_extra_rules)
+    _extra_rules[:] = [(rx, sp) for rx, sp in _extra_rules
+                       if rx.pattern != pattern]
+    return before - len(_extra_rules)
+
+
+def _resolve_rule_spec(rx, spec, name, ndim) -> P:
+    spec = spec(ndim) if callable(spec) else spec
+    if spec is None:
+        spec = P()
+    if len(tuple(spec)) > ndim:
+        raise ValueError(
+            f"tp rule {rx.pattern!r} produced PartitionSpec {spec} with "
+            f"{len(tuple(spec))} entries for rank-{ndim} param {name!r} — "
+            "register a callable spec builder (ndim -> P) or scope the "
+            "pattern to params of the right rank")
+    return spec
 
 
 def _match(name, patterns):
@@ -67,7 +94,7 @@ def param_spec_for(name: str, ndim: int, mesh: Optional[Mesh] = None,
 
     for rx, spec in reversed(_extra_rules):
         if rx.search(name):
-            return spec
+            return _resolve_rule_spec(rx, spec, name, ndim)
     if has_tp and ndim >= 2:
         if _match(name, COLUMN_PARALLEL):
             return P(*([None] * (ndim - 1) + ["tp"]))

@@ -28,6 +28,8 @@ from .spmd_analyzer import (Collective, SpmdDiagnostic,  # noqa: F401
                             analyze_program, maybe_verify_spmd,
                             register_spmd_rule, set_verify_spmd,
                             verify_spmd_enabled)
+from .spmd_planner import (PlanRule, ShardingPlan,  # noqa: F401
+                           plan_program, resolve_auto_shard)
 from .verifier import ProgramVerifyError, verify_program  # noqa: F401
 
 __all__ = ["data", "InputSpec", "Program", "Variable", "Executor",
@@ -42,8 +44,9 @@ __all__ = ["data", "InputSpec", "Program", "Variable", "Executor",
            "analyze_program", "analyze_params", "SpmdLintError",
            "SpmdReport", "SpmdDiagnostic", "Collective",
            "register_spmd_rule", "set_verify_spmd", "verify_spmd_enabled",
-           "maybe_verify_spmd", "PipelineRunner", "FetchHandle",
-           "PipelineStepError"]
+           "maybe_verify_spmd", "ShardingPlan", "PlanRule",
+           "plan_program", "resolve_auto_shard", "PipelineRunner",
+           "FetchHandle", "PipelineStepError"]
 
 
 def data(name, shape, dtype="float32", lod_level=0):

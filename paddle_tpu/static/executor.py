@@ -209,6 +209,13 @@ class Executor:
         from ..core import flags as _flags0
         from ..core import monitor as _monitor
         from ..core import trace as _trace
+        # strategy.auto_shard (fleet.distributed_optimizer): derive the
+        # PartitionSpec plan for this program at compile, BEFORE the
+        # verify/estimate hooks below read program.spmd_*_specs
+        if getattr(program, "_auto_shard", None) is not None \
+                and getattr(program, "spmd_param_specs", None) is None:
+            from .spmd_planner import resolve_auto_shard
+            resolve_auto_shard(program)
         # PADDLE_TPU_VERIFY_SPMD: sharding findings (unbound axis,
         # non-divisible dim, implied reshard, ...) fail HERE — before
         # jit tracing, where they would surface as silent replication

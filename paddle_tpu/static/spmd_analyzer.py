@@ -106,13 +106,31 @@ class SpmdDiagnostic:
 class Collective:
     """One implied collective. `bytes` is the per-device payload: the
     tensor's logical nbytes divided by the shard divisor of the dims NOT
-    taking part in the communication."""
+    taking part in the communication. `dtype` is the element type riding
+    the wire (numpy name), so quantized-collective analysis can re-price
+    the payload under a narrower cast without re-walking the program."""
     kind: str          # all_reduce | all_gather
     axis: str          # mesh axis (comma-joined when a dim carries several)
     bytes: int
     op_index: Optional[int] = None
     op_name: Optional[str] = None
     var: Optional[str] = None
+    dtype: Optional[str] = None
+
+    def bytes_if(self, dtype) -> int:
+        """Per-device payload bytes if the wire format were `dtype`
+        (the EQuARX quantized-AllReduce seam: int8/fp8 block-scaled
+        collectives keep the element COUNT, shrink the element size)."""
+        if self.dtype is None:
+            return self.bytes
+        old = np.dtype(self.dtype).itemsize
+        new = np.dtype(dtype).itemsize
+        return (self.bytes * new) // max(old, 1)
+
+    @property
+    def is_float(self) -> bool:
+        return self.dtype is not None and \
+            np.issubdtype(np.dtype(self.dtype), np.floating)
 
 
 def _spec_str(entries) -> str:
@@ -168,6 +186,21 @@ class SpmdReport:
                             op_name=first.op_name, op_index=first.op_index,
                             var=first.var, axis=first.axis)
 
+    def quantized_savings(self, dtype="int8") -> Dict[str, dict]:
+        """Per-mesh-axis wire-byte savings if every FLOAT collective were
+        cast to `dtype` on the wire (EQuARX-style quantized AllReduce;
+        integer payloads — index gathers etc. — are left untouched).
+        Returns {axis: {bytes, bytes_quantized, saved}}."""
+        out: Dict[str, dict] = {}
+        for c in self.collectives:
+            row = out.setdefault(c.axis, {"bytes": 0, "bytes_quantized": 0,
+                                          "saved": 0})
+            q = c.bytes_if(dtype) if c.is_float else c.bytes
+            row["bytes"] += c.bytes
+            row["bytes_quantized"] += q
+            row["saved"] += c.bytes - q
+        return out
+
     def render(self) -> str:
         """Human-readable report (tools/spmd_lint.py)."""
         lines = ["spmd analysis: mesh {" + ", ".join(
@@ -183,6 +216,18 @@ class SpmdReport:
                 lines.append(f"  {kind:<12}{axis:<8}{len(cs):>6}"
                              f"{sum(c.bytes for c in cs):>14}")
             lines.append(f"collective bytes/step: {self.collective_bytes()}")
+            savings = self.quantized_savings("int8")
+            if any(row["saved"] for row in savings.values()):
+                lines.append("int8/fp8 quantized collectives would save "
+                             "(per mesh axis, float payloads only):")
+                for axis, row in sorted(savings.items()):
+                    if not row["saved"]:
+                        continue
+                    ratio = row["bytes"] / max(row["bytes_quantized"], 1)
+                    lines.append(
+                        f"  axis {axis}: {row['bytes']} B -> "
+                        f"{row['bytes_quantized']} B "
+                        f"(saves {row['saved']} B, {ratio:.1f}x)")
         else:
             lines.append("collectives per step: none")
         if self.hbm:
@@ -309,11 +354,14 @@ class _Ctx:
                     d *= self.axes.get(ax, 1)
         return _nbytes(aval) // max(d, 1)
 
-    def collective(self, kind, entry, bytes_, var=None):
+    def collective(self, kind, entry, bytes_, var=None, aval=None,
+                   dtype=None):
+        if dtype is None and aval is not None:
+            dtype = np.dtype(aval.dtype).name
         self.collectives.append(Collective(
             kind=kind, axis=",".join(entry) if not isinstance(entry, str)
             else entry, bytes=int(bytes_), op_index=self.op_index,
-            op_name=self.op_name, var=var))
+            op_name=self.op_name, var=var, dtype=dtype))
 
     def diag(self, code, message, var=None, axis=None):
         self.report.diagnostics.append(SpmdDiagnostic(
@@ -429,7 +477,7 @@ def _merge_elementwise(ctx, ins, out_aval, var):
                     var=var, axis=",".join(ent))
                 ctx.collective("all_gather", ent,
                                ctx.payload(v.aval, v.spec, exclude=ent),
-                               var=var)
+                               var=var, aval=v.aval)
     return tuple(out)
 
 
@@ -474,9 +522,11 @@ def _matmul_rule(ctx, ins, kw, out_avals, var):
 
     if xc and yc and xc == yc:
         # true contraction sharding: partial sums -> all-reduce of the out
-        out_spec_final = _finalize(ctx, full, vec_x, vec_y, out_aval)
+        out_spec_final = _finalize(ctx, full, vec_x, vec_y, out_aval,
+                                   var=var)
         ctx.collective("all_reduce", xc,
-                       ctx.payload(out_aval, out_spec_final), var=var)
+                       ctx.payload(out_aval, out_spec_final), var=var,
+                       aval=out_aval)
         return [out_spec_final]
     if xc or yc:
         if xc and yc:
@@ -499,26 +549,44 @@ def _matmul_rule(ctx, ins, kw, out_avals, var):
             if ent:
                 ctx.collective("all_gather", ent,
                                ctx.payload(side.aval, side.spec,
-                                           exclude=ent), var=var)
-    return [_finalize(ctx, full, vec_x, vec_y, out_aval)]
+                                           exclude=ent), var=var,
+                               aval=side.aval)
+    return [_finalize(ctx, full, vec_x, vec_y, out_aval, var=var)]
 
 
-def _finalize(ctx, full, vec_x, vec_y, out_aval):
+def _finalize(ctx, full, vec_x, vec_y, out_aval, var=None):
     """Drop the padded vector dims and de-duplicate axes across dims (an
-    axis cannot shard two output dims — e.g. both operands column-
-    sharded on the same axis)."""
+    axis cannot shard two output dims — e.g. a dp-sharded batch meeting
+    a dp-column-sharded weight). The drop is NOT free: the operand that
+    loses its sharding must be re-laid-out, so each dropped axis is
+    PRICED as a reshard + all-gather of the output over that axis —
+    otherwise a layout search against this cost model would "win" by
+    sharding every weight on the batch axis at no modeled cost."""
     if vec_y:
         full = full[:-1]
     if vec_x:
         full = full[:-2] + full[-1:] if not vec_y else full[:-1]
     seen: set = set()
     out = []
-    for ent in full:
+    dropped: list = []
+    for d, ent in enumerate(full):
         kept = tuple(ax for ax in ent if ax not in seen)
         seen.update(kept)
         out.append(kept)
+        dropped += [(d, ax) for ax in ent if ax not in kept]
     out = (out + [()] * len(out_aval.shape))[:len(out_aval.shape)]
-    return tuple(out)
+    out = tuple(out)
+    for d, ax in dropped:
+        ctx.diag(
+            "reshard",
+            f"matmul output dim {d} would reuse axis '{ax}', already "
+            "sharding an earlier output dim — one axis cannot shard two "
+            "dims, so the conflicting operand sharding is implicitly "
+            "all-gathered", var=var, axis=ax)
+        ctx.collective("all_gather", (ax,),
+                       ctx.payload(out_aval, out, exclude=(ax,)),
+                       var=var, aval=out_aval)
+    return out
 
 
 @register_spmd_rule("embedding")
@@ -531,6 +599,38 @@ def _embedding_rule(ctx, ins, kw, out_avals, var):
     d_ent = w.spec[1] if len(w.spec) > 1 else ()
     ids_spec = ids.spec if isinstance(ids, _AV) and ids.aval is not None \
         else ((),) * (len(out_aval.shape) - 1)
+    used = {ax for e in ids_spec for ax in e}
+    if v_ent and any(ax in used for ax in v_ent):
+        # vocab-parallel over an axis that ALSO shards the ids: the
+        # masked-partial all-reduce would mix different batch rows —
+        # GSPMD must all-gather the table instead
+        drop = tuple(ax for ax in v_ent if ax in used)
+        ctx.diag(
+            "reshard",
+            f"embedding weight '{var}' is vocab-sharded on axis "
+            f"{','.join(drop)} which also shards the ids — the "
+            "vocab-parallel gather cannot reduce across it; the table "
+            "is implicitly all-gathered", var=var, axis=",".join(drop))
+        ctx.collective("all_gather", drop,
+                       ctx.payload(w.aval, w.spec, exclude=drop),
+                       var=var, aval=w.aval)
+        v_ent = tuple(ax for ax in v_ent if ax not in drop)
+    if d_ent and any(ax in used for ax in d_ent):
+        # the embed-dim sharding collides with an id-batch axis: the
+        # gather result cannot carry one axis on two dims — priced like
+        # the matmul _finalize drop, not silently free
+        drop = tuple(ax for ax in d_ent if ax in used)
+        ctx.diag(
+            "reshard",
+            f"embedding output embed dim would reuse axis "
+            f"{','.join(drop)}, already sharding the id batch — the "
+            "weight's embed-dim sharding is implicitly all-gathered",
+            var=var, axis=",".join(drop))
+        d_ent = tuple(ax for ax in d_ent if ax not in used)
+        out_probe = tuple(ids_spec) + (d_ent,)
+        ctx.collective("all_gather", drop,
+                       ctx.payload(out_aval, out_probe, exclude=drop),
+                       var=var, aval=out_aval)
     out_spec = tuple(ids_spec) + (d_ent,)
     out_spec = (out_spec + ((),) * len(out_aval.shape))[
         :len(out_aval.shape)]
@@ -538,7 +638,8 @@ def _embedding_rule(ctx, ins, kw, out_avals, var):
         # vocab-parallel gather: each shard contributes its rows, the
         # masked partial results sum across the vocab axis
         ctx.collective("all_reduce", v_ent,
-                       ctx.payload(out_aval, out_spec), var=var)
+                       ctx.payload(out_aval, out_spec), var=var,
+                       aval=out_aval)
     return [out_spec]
 
 
@@ -612,7 +713,7 @@ def _reshape_like_rule(ctx, ins, kw, out_avals, var):
                 "all-gather", var=var, axis=",".join(ent))
             ctx.collective("all_gather", ent,
                            ctx.payload(x.aval, x.spec, exclude=ent),
-                           var=var)
+                           var=var, aval=x.aval)
     return [tuple(out)]
 
 
@@ -659,7 +760,7 @@ def _concat_rule(ctx, ins, kw, out_avals, var):
                     var=var, axis=",".join(ent))
                 ctx.collective("all_gather", ent,
                                ctx.payload(v.aval, v.spec, exclude=ent),
-                               var=var)
+                               var=var, aval=v.aval)
                 continue
             if not out[d] and all(used.get(ax, d) == d for ax in ent):
                 out[d] = ent
@@ -673,7 +774,7 @@ def _concat_rule(ctx, ins, kw, out_avals, var):
                     "implicit all-gather", var=var, axis=",".join(ent))
                 ctx.collective("all_gather", ent,
                                ctx.payload(v.aval, v.spec, exclude=ent),
-                               var=var)
+                               var=var, aval=v.aval)
     return [tuple(out)]
 
 
@@ -730,7 +831,8 @@ def _reduce_rule(ctx, ins, kw, out_avals, var):
     out = (out + [()] * len(out_aval.shape))[:len(out_aval.shape)]
     if comm:
         ctx.collective("all_reduce", comm,
-                       ctx.payload(out_aval, tuple(out)), var=var)
+                       ctx.payload(out_aval, tuple(out)), var=var,
+                       aval=out_aval)
     return [tuple(out)]
 
 
@@ -748,7 +850,7 @@ def _softmax_rule(ctx, ins, kw, out_avals, var):
         ctx.collective("all_reduce", spec[axis],
                        ctx.payload(out_aval, tuple(
                            e for d, e in enumerate(spec) if d != axis)),
-                       var=var)
+                       var=var, aval=out_aval)
     return [tuple(spec)]
 
 
@@ -771,7 +873,7 @@ def _layer_norm_rule(ctx, ins, kw, out_avals, var):
                 "all-gather/all-reduce", var=var, axis=",".join(spec[d]))
             ctx.collective("all_gather", spec[d],
                            ctx.payload(x.aval, x.spec, exclude=spec[d]),
-                           var=var)
+                           var=var, aval=x.aval)
             spec[d] = ()
     return [tuple(spec)]
 
@@ -796,7 +898,8 @@ def _fused_ce_rule(ctx, ins, kw, out_avals, var):
             and weight.spec and weight.spec[0]:
         # vocab-parallel head: the logsumexp reduces across the vocab axis
         ctx.collective("all_reduce", weight.spec[0],
-                       ctx.payload(out_aval, out_spec), var=var)
+                       ctx.payload(out_aval, out_spec), var=var,
+                       aval=out_aval)
     return [out_spec]
 
 
@@ -1079,10 +1182,12 @@ def analyze_params(params, mesh=None, specs=None, tokens_per_step=None,
         if len(aval.shape) >= 2 and norm[0]:
             if sharding_mod._match(name, sharding_mod.VOCAB_PARALLEL):
                 ctx.collective("all_reduce", norm[0],
-                               rows * aval.shape[1] * itemsize, var=name)
+                               rows * aval.shape[1] * itemsize, var=name,
+                               aval=aval)
             elif sharding_mod._match(name, sharding_mod.ROW_PARALLEL):
                 ctx.collective("all_reduce", norm[0],
-                               rows * aval.shape[1] * itemsize, var=name)
+                               rows * aval.shape[1] * itemsize, var=name,
+                               aval=aval)
     report.hbm = {"peak_bytes": param_bytes, "param_bytes": param_bytes,
                   "feed_bytes": 0, "activation_peak_bytes": 0,
                   "timeline": [], "peak_op": None}

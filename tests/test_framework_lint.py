@@ -106,3 +106,68 @@ def test_concretization_hazards_detected_and_pragma_suppresses():
     assert ".item()" in joined
     assert "fine_op" not in joined
     assert "waived" not in joined
+
+
+def test_perf_floors_clean_on_committed_evidence():
+    """The committed HLO_EVIDENCE.json must clear every floor — this is
+    the tier-1 perf-regression gate (ROADMAP) while the TPU bench
+    tunnel is down."""
+    assert framework_lint.check_perf_floors() == []
+
+
+def test_perf_floor_regression_detected():
+    with open(framework_lint.EVIDENCE_PATH) as f:
+        evidence = json.load(f)
+    evidence["graphs"]["gpt_decode_step"]["attention_per_step"][
+        "flops_reduction_x"] = 1.3  # below the 2x floor
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "HLO_EVIDENCE.json")
+        with open(path, "w") as f:
+            json.dump(evidence, f)
+        problems = framework_lint.check_perf_floors(path)
+    assert len(problems) == 1
+    assert "decode-attention FLOPs reduction" in problems[0]
+    assert "1.3" in problems[0] and "2.0" in problems[0]
+
+
+def test_perf_floor_missing_metric_detected():
+    with open(framework_lint.EVIDENCE_PATH) as f:
+        evidence = json.load(f)
+    del evidence["graphs"]["serve_decode"]["kv_bytes_per_step"][
+        "bytes_reduction_x_at_typical_fill"]
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "HLO_EVIDENCE.json")
+        with open(path, "w") as f:
+            json.dump(evidence, f)
+        problems = framework_lint.check_perf_floors(path)
+    assert len(problems) == 1
+    assert "serve_decode KV-bytes reduction" in problems[0]
+    assert "missing" in problems[0]
+
+
+def test_perf_floor_missing_or_corrupt_file_detected():
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "HLO_EVIDENCE.json")
+        problems = framework_lint.check_perf_floors(path)
+        assert len(problems) == 1 and "not found" in problems[0]
+        with open(path, "w") as f:
+            f.write("{broken")
+        problems = framework_lint.check_perf_floors(path)
+        assert len(problems) == 1 and "not valid JSON" in problems[0]
+
+
+def test_perf_floor_null_metric_detected():
+    """Review fix: a legitimately-null JSON leaf must NOT slip through
+    the missing-key guard — it is a non-numeric violation."""
+    with open(framework_lint.EVIDENCE_PATH) as f:
+        evidence = json.load(f)
+    evidence["graphs"]["pipeline_scan_megastep"]["dispatch_model"][
+        "dispatch_reduction_x"] = None
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "HLO_EVIDENCE.json")
+        with open(path, "w") as f:
+            json.dump(evidence, f)
+        problems = framework_lint.check_perf_floors(path)
+    assert len(problems) == 1
+    assert "scan-fused dispatch reduction" in problems[0]
+    assert "non-numeric" in problems[0]

@@ -437,3 +437,108 @@ def test_pipeline_schedule_collectives():
     pcv = schedule_collectives(8, 4, 1024, schedule="interleaved",
                                num_virtual=2)
     assert pcv["count"] == 2 * 8 + 4 - 1
+
+
+# ---------------------------------------------------------------------------
+# satellites (ISSUE 10): collective dtype dimension + quantized savings,
+# cross-dim duplicate-axis pricing, add_tp_rule callable/rank validation
+# ---------------------------------------------------------------------------
+
+def test_collective_dtype_recorded_and_bytes_if():
+    """Every collective carries its wire dtype; bytes_if re-prices the
+    payload under a narrower cast (the EQuARX quantized seam)."""
+    paddle.enable_static()
+    try:
+        main, net, _ = _linear_program()
+        rep = analyze_program(main, mesh=MESH, param_specs={
+            net.weight.scope_name: P("tp", None)},
+            data_specs={"x": P(None, "tp")})  # row-parallel: 1 all-reduce
+        assert rep.diagnostics == []
+        (ar,) = [c for c in rep.collectives if c.kind == "all_reduce"]
+        assert ar.dtype == "float32" and ar.is_float
+        assert ar.bytes_if("int8") == ar.bytes // 4
+        assert ar.bytes_if("float16") == ar.bytes // 2
+        assert ar.bytes_if("float32") == ar.bytes
+    finally:
+        paddle.disable_static()
+
+
+def test_quantized_savings_per_axis_in_render(static_mode):
+    from paddle_tpu.text.models.gpt import GPT, GPTConfig
+    main = static.Program("q")
+    with static.program_guard(main):
+        ids = static.data("input_ids", [2, 16], "int64")
+        net = GPT(GPTConfig.tiny())
+        logits = net(ids)
+    main._jit_fetch_vars = [logits]
+    specs = sharding.named_param_specs(net, {"tp": 2})
+    rep = analyze_program(main, mesh={"tp": 2}, param_specs=specs)
+    savings = rep.quantized_savings("int8")
+    assert set(savings) == {"tp"}
+    row = savings["tp"]
+    assert row["bytes"] == rep.collective_bytes() > 0
+    assert row["bytes_quantized"] == row["bytes"] // 4  # all-f32 wire
+    assert row["saved"] == row["bytes"] - row["bytes_quantized"]
+    out = rep.render()
+    assert "int8/fp8 quantized collectives would save" in out
+    assert f"saves {row['saved']} B" in out
+
+
+def test_matmul_output_axis_collision_is_priced(static_mode):
+    """dp-sharded batch meeting a dp-column-sharded weight: the axis
+    cannot shard two output dims — must surface as a PRICED reshard,
+    not a silently free drop (the planner would otherwise exploit it)."""
+    main, net, y = _linear_program()
+    rep = analyze_program(main, mesh=MESH, param_specs={
+        net.weight.scope_name: P(None, "dp")},
+        data_specs={"x": P("dp")})
+    assert "reshard" in [d.code for d in rep.diagnostics]
+    d = next(x for x in rep.diagnostics if x.code == "reshard")
+    assert "cannot shard two" in d.message and d.axis == "dp"
+    ag = [c for c in rep.collectives if c.kind == "all_gather"]
+    assert len(ag) == 1 and ag[0].axis == "dp" and ag[0].bytes > 0
+    # batch keeps dp; the weight's column sharding lost
+    assert rep.spec_of(y) == (("dp",), ())
+
+
+def test_embedding_vocab_axis_colliding_with_ids_is_priced(static_mode):
+    main = static.Program("emb")
+    with static.program_guard(main):
+        ids = static.data("ids", [4, 8], "int64")
+        emb = nn.Embedding(16, 6)
+        out = emb(ids)
+    main._jit_fetch_vars = [out]
+    rep = analyze_program(main, mesh=MESH, param_specs={
+        emb.weight.scope_name: P("dp", None)},
+        data_specs={"ids": P("dp")})
+    codes = [d.code for d in rep.diagnostics]
+    assert codes == ["reshard"]
+    assert "vocab-sharded" in rep.diagnostics[0].message
+    ag = [c for c in rep.collectives if c.kind == "all_gather"]
+    assert len(ag) == 1 and ag[0].axis == "dp"
+    assert [c for c in rep.collectives if c.kind == "all_reduce"] == []
+
+
+def test_add_tp_rule_accepts_callable_and_validates_rank():
+    meshlike = sharding.mesh_like({"tp": 2})
+    # a callable rule serves multiple ranks from one template
+    sharding.add_tp_rule(r"my_head\.weight$",
+                         lambda ndim: P(*([None] * (ndim - 1) + ["tp"])))
+    try:
+        assert sharding.param_spec_for("my_head.weight", 2, meshlike) \
+            == P(None, "tp")
+        assert sharding.param_spec_for("my_head.weight", 3, meshlike) \
+            == P(None, None, "tp")
+    finally:
+        assert sharding.remove_tp_rule(r"my_head\.weight$") == 1
+    # a fixed over-rank spec fails AT MATCH TIME, naming the rule —
+    # not as a spec-rank crash downstream
+    sharding.add_tp_rule(r"tiny\.bias$", P("tp", None))
+    try:
+        with pytest.raises(ValueError, match="rank-1 param 'tiny.bias'"):
+            sharding.param_spec_for("tiny.bias", 1, meshlike)
+        # matching rank still works
+        assert sharding.param_spec_for("tiny.bias", 2, meshlike) \
+            == P("tp", None)
+    finally:
+        assert sharding.remove_tp_rule(r"tiny\.bias$") == 1

@@ -46,3 +46,23 @@ def test_registered_in_framework_lint_cross_checks():
     assert "spmd_lint" in framework_lint.TOOL_CROSS_CHECKS
     # and the registry check actually ran it (clean repo -> no findings)
     assert framework_lint.check_registered_tools() == []
+
+
+def test_inject_nondivisible_does_not_corrupt_program():
+    """The --inject non-divisible seam (ISSUE 10 satellite): repeated
+    build_report calls in one process must not see the corrupted aval —
+    the seam now swaps an aval VIEW into a cloned Program instead of
+    mutating the real persistable."""
+    report, program, _ = spmd_lint.build_report(inject="non-divisible")
+    assert any(d.code == "non-divisible" for d in report.diagnostics)
+    # the injected program carries the odd vocab...
+    wte = next(v for v in program.persistable_vars.values()
+               if v.aval.shape[1] == 64 and v.aval.shape[0] % 2 == 1)
+    assert wte.aval.shape[0] == 1025
+    # ...but a fresh build in the same process is pristine
+    report2, program2, _ = spmd_lint.build_report()
+    assert report2.diagnostics == []
+    assert all(v.aval.shape[0] % 2 == 0
+               for v in program2.persistable_vars.values()
+               if len(v.aval.shape) == 2 and v.aval.shape[1] == 64)
+    assert spmd_lint.self_check() == []

@@ -359,9 +359,9 @@ def check_concretization(ops_dir=OPS_DIR):
 # Cross-check registry: domain lints that ride along with the framework
 # gate. Each module lives in tools/, exposes `self_check()` returning a
 # list of violation strings, and `main(argv)` for standalone use.
-TOOL_CROSS_CHECKS = ["spmd_lint", "hlo_evidence", "pipeline_lint",
-                     "obs_report", "ps_load_test", "elastic_drill",
-                     "serve_load_test"]
+TOOL_CROSS_CHECKS = ["spmd_lint", "spmd_plan", "hlo_evidence",
+                     "pipeline_lint", "obs_report", "ps_load_test",
+                     "elastic_drill", "serve_load_test"]
 
 
 def check_registered_tools():
@@ -386,11 +386,84 @@ def check_registered_tools():
 
 
 # ---------------------------------------------------------------------------
+# check 4: perf floors over the committed HLO evidence
+# ---------------------------------------------------------------------------
+
+EVIDENCE_PATH = os.path.join(REPO, "HLO_EVIDENCE.json")
+
+# The committed HLO_EVIDENCE.json is the repo's perf record of truth
+# while the live-TPU bench tunnel is down (ROADMAP). These are the
+# headline ratios each kernel PR proved; a regenerated evidence file
+# that regresses below a floor FAILS the build instead of silently
+# rewriting the record. (label, path-into-the-json, floor)
+PERF_FLOORS = [
+    ("decode-attention FLOPs reduction",
+     ("graphs", "gpt_decode_step", "attention_per_step",
+      "flops_reduction_x"), 2.0),
+    ("decode-attention bytes reduction",
+     ("graphs", "gpt_decode_step", "attention_per_step",
+      "bytes_reduction_x"), 2.0),
+    ("serve_decode KV-bytes reduction",
+     ("graphs", "serve_decode", "kv_bytes_per_step",
+      "bytes_reduction_x_at_typical_fill"), 2.0),
+    ("scan-fused dispatch reduction",
+     ("graphs", "pipeline_scan_megastep", "dispatch_model",
+      "dispatch_reduction_x"), 2.0),
+]
+
+
+def check_perf_floors(evidence_path=EVIDENCE_PATH, floors=None):
+    """Returns a list of violation strings (empty = clean)."""
+    problems = []
+    try:
+        with open(evidence_path) as f:
+            evidence = json.load(f)
+    except FileNotFoundError:
+        return [f"{os.path.basename(evidence_path)} not found — the "
+                "committed HLO evidence is the perf record of truth; "
+                "regenerate with `python tools/hlo_evidence.py`"]
+    except json.JSONDecodeError as e:
+        return [f"{os.path.basename(evidence_path)} is not valid JSON "
+                f"({e}) — regenerate with `python tools/hlo_evidence.py`"]
+    missing = object()  # distinct from a legitimately-null JSON leaf
+    for label, path, floor in (PERF_FLOORS if floors is None else floors):
+        node = evidence
+        for key in path:
+            if not isinstance(node, dict) or key not in node:
+                problems.append(
+                    f"perf floor '{label}': {'/'.join(path)} missing from "
+                    f"{os.path.basename(evidence_path)} — the evidence "
+                    "record lost a headline metric; regenerate with "
+                    "`python tools/hlo_evidence.py` (a restructure needs "
+                    "a matching PERF_FLOORS update)")
+                node = missing
+                break
+            node = node[key]
+        if node is missing:
+            continue
+        try:
+            value = float(node)
+        except (TypeError, ValueError):
+            problems.append(
+                f"perf floor '{label}': {'/'.join(path)} is "
+                f"non-numeric ({node!r})")
+            continue
+        if value < floor:
+            problems.append(
+                f"perf floor '{label}': {value}x regressed below the "
+                f"{floor}x floor — an evidence regeneration may not "
+                "silently rewrite the perf record; fix the kernel path "
+                "or justify a floor change in the PR")
+    return problems
+
+
+# ---------------------------------------------------------------------------
 
 def run_lint(spec_path=SPEC_PATH, versions_path=VERSIONS_PATH,
              ops_dir=OPS_DIR):
     problems = check_registry_spec(spec_path, versions_path)
     problems += check_concretization(ops_dir)
+    problems += check_perf_floors()
     problems += check_registered_tools()
     return problems
 

@@ -29,21 +29,19 @@ INJECTIONS = ("unbound-axis", "non-divisible", "duplicate-axis",
               "spec-rank")
 
 
-def build_report(tp=2, dp=1, layers=2, hidden=64, heads=2, vocab=1024,
-                 batch=2, seq=16, inject=None):
-    """Trace the GPT forward statically and analyze it. Returns
-    (report, program, logits_var)."""
+def build_gpt_program(layers=2, hidden=64, heads=2, vocab=1024, batch=2,
+                      seq=16, name="spmd_lint_gpt"):
+    """Trace the GPT forward statically (the shared golden workload —
+    tools/spmd_plan.py plans the same program this lint prices).
+    Returns (program, net, logits_var); restores the caller's mode."""
     import paddle_tpu as paddle
-    from jax.sharding import PartitionSpec as P
     from paddle_tpu import static
-    from paddle_tpu.distributed import sharding
-    from paddle_tpu.static import spmd_analyzer as spmd
     from paddle_tpu.text.models.gpt import GPT, GPTConfig
 
     was_static = static.in_static_mode()
     paddle.enable_static()
     try:
-        main = static.Program("spmd_lint_gpt")
+        main = static.Program(name)
         with static.program_guard(main):
             ids = static.data("input_ids", [batch, seq], "int64")
             net = GPT(GPTConfig(vocab_size=vocab, hidden_size=hidden,
@@ -52,38 +50,67 @@ def build_report(tp=2, dp=1, layers=2, hidden=64, heads=2, vocab=1024,
                                 max_seq_len=max(seq, 8)))
             logits = net(ids)
         main._jit_fetch_vars = [logits]
-
-        mesh = {}
-        if dp > 1:
-            mesh["dp"] = dp
-        if tp > 1:
-            mesh["tp"] = tp
-        specs = sharding.named_param_specs(net, mesh)
-        if inject:
-            # demo/self-test seams: corrupt ONE spec the named way
-            name = next(n for n in specs
-                        if n == net.wte.weight.scope_name)
-            specs[name] = {
-                "unbound-axis": P("mp", None),
-                "duplicate-axis": P("tp", "tp"),
-                "non-divisible": None,  # handled below via odd vocab
-                "spec-rank": P("tp", None, "tp"),
-            }[inject]
-            if inject == "non-divisible":
-                # a vocab the tp axis cannot divide
-                import jax
-                pv = main.persistable_vars[name]
-                pv.aval = jax.ShapeDtypeStruct(
-                    (pv.aval.shape[0] + 1, pv.aval.shape[1]),
-                    pv.aval.dtype)
-                specs[name] = P("tp", None)
-        data_specs = {"input_ids": P("dp")} if dp > 1 else None
-        report = spmd.analyze_program(main, mesh=mesh, param_specs=specs,
-                                      data_specs=data_specs)
-        return report, main, logits
+        return main, net, logits
     finally:
         if not was_static:
             paddle.disable_static()
+
+
+class _AvalView:
+    """Persistable stand-in carrying a DIFFERENT aval. The --inject
+    non-divisible seam used to overwrite the real Variable's aval in
+    place — corrupting the net and program for every later
+    `build_report` in the same process; the view (on a cloned Program)
+    leaves the original untouched."""
+
+    def __init__(self, pv, aval):
+        self.name = pv.name
+        self.scope_name = pv.scope_name
+        self.aval = aval
+
+
+def build_report(tp=2, dp=1, layers=2, hidden=64, heads=2, vocab=1024,
+                 batch=2, seq=16, inject=None):
+    """Trace the GPT forward statically and analyze it. Returns
+    (report, program, logits_var)."""
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed import sharding
+    from paddle_tpu.static import spmd_analyzer as spmd
+
+    main, net, logits = build_gpt_program(layers=layers, hidden=hidden,
+                                          heads=heads, vocab=vocab,
+                                          batch=batch, seq=seq)
+    mesh = {}
+    if dp > 1:
+        mesh["dp"] = dp
+    if tp > 1:
+        mesh["tp"] = tp
+    specs = sharding.named_param_specs(net, mesh)
+    if inject:
+        # demo/self-test seams: corrupt ONE spec the named way
+        name = next(n for n in specs
+                    if n == net.wte.weight.scope_name)
+        specs[name] = {
+            "unbound-axis": P("mp", None),
+            "duplicate-axis": P("tp", "tp"),
+            "non-divisible": None,  # handled below via odd vocab
+            "spec-rank": P("tp", None, "tp"),
+        }[inject]
+        if inject == "non-divisible":
+            # a vocab the tp axis cannot divide — swapped in as a view
+            # on a CLONED program; the real Variable keeps its aval
+            import jax
+            main = main.clone()
+            pv = main.persistable_vars[name]
+            main.persistable_vars[name] = _AvalView(
+                pv, jax.ShapeDtypeStruct(
+                    (pv.aval.shape[0] + 1, pv.aval.shape[1]),
+                    pv.aval.dtype))
+            specs[name] = P("tp", None)
+    data_specs = {"input_ids": P("dp")} if dp > 1 else None
+    report = spmd.analyze_program(main, mesh=mesh, param_specs=specs,
+                                  data_specs=data_specs)
+    return report, main, logits
 
 
 def self_check():

@@ -1,0 +1,196 @@
+"""Auto-sharding plan CLI — front-end for static/spmd_planner.py.
+
+Plans the GPT workload (the same program tools/spmd_lint.py prices) for
+a given `{axis: size}` mesh — device-free, so a pod layout plans from
+any dev box — and prints the searched plan as a human-auditable rule
+list next to a predicted-cost table: planned layout vs the hand-written
+`sharding.py` preset vs full replication. Exit 1 when the plan carries
+diagnostics or loses to the preset on either predicted metric.
+
+  python tools/spmd_plan.py                  # tiny GPT, tp=2
+  python tools/spmd_plan.py --tp 4 --dp 2 --layers 12 --hidden 768
+  python tools/spmd_plan.py --tp 2 --dp 2 --sp 2   # hybrid mesh
+  python tools/spmd_plan.py --json           # stable output for CI
+
+`self_check()` (registered in tools/framework_lint.py TOOL_CROSS_CHECKS
+and run by tests/test_spmd_planner.py in tier-1) pins the golden
+rediscovery: on a tp-only mesh the search must reproduce the Megatron
+layout (qkv/fc1 column-parallel, out-proj/fc2 row-parallel, wte
+vocab-parallel) with zero diagnostics at preset-or-better predicted
+cost, and a dp×tp mesh must shard the `input_ids` feed on dp.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))  # sibling spmd_lint
+
+
+def build_plan(tp=2, dp=1, sp=1, layers=2, hidden=64, heads=2, vocab=1024,
+               batch=2, seq=16, beam=None, coll_weight=None,
+               hbm_weight=None, zero_dp=False):
+    """Plan the GPT workload. Returns (plan, preset_report,
+    replicated_report, program, net, logits)."""
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed import sharding
+    from paddle_tpu.static import spmd_analyzer as spmd
+    from paddle_tpu.static import spmd_planner
+    from spmd_lint import build_gpt_program
+
+    mesh = {}
+    if dp > 1:
+        mesh["dp"] = dp
+    if tp > 1:
+        mesh["tp"] = tp
+    if sp > 1:
+        mesh["sp"] = sp
+    program, net, logits = build_gpt_program(
+        layers=layers, hidden=hidden, heads=heads, vocab=vocab,
+        batch=batch, seq=seq, name="spmd_plan_gpt")
+    plan = spmd_planner.plan_program(
+        program, mesh, layer=net, beam=beam, coll_weight=coll_weight,
+        hbm_weight=hbm_weight, zero_dp=zero_dp)
+    preset_specs = sharding.named_param_specs(net, mesh)
+    preset_data = {"input_ids": P("dp")} if dp > 1 else None
+    preset = spmd.analyze_program(program, mesh=mesh,
+                                  param_specs=preset_specs,
+                                  data_specs=preset_data)
+    replicated = spmd.analyze_program(program, mesh=mesh, param_specs={})
+    return plan, preset, replicated, program, net, logits
+
+
+def _metrics(report):
+    return {"collective_bytes": report.collective_bytes(),
+            "hbm_peak": report.hbm["peak_bytes"] if report.hbm else 0,
+            "diagnostics": len(report.diagnostics)}
+
+
+def plan_json(plan, preset, replicated) -> dict:
+    """Stable JSON for CI: the plan's rule list + the three-way cost
+    table + the acceptance verdict."""
+    out = plan.to_json()
+    out["preset"] = _metrics(preset)
+    out["replicated"] = _metrics(replicated)
+    p = out["predicted"]
+    out["ok"] = bool(
+        p["diagnostics"] == 0
+        and p["collective_bytes"] <= out["preset"]["collective_bytes"]
+        and p["hbm_peak"] <= out["preset"]["hbm_peak"])
+    return out
+
+
+def render_table(plan, preset, replicated) -> str:
+    rows = [("planned", plan.predicted),
+            ("preset", _metrics(preset)),
+            ("replicated", _metrics(replicated))]
+    lines = ["predicted cost (collective B/step, peak HBM B/device, "
+             "diagnostics):"]
+    lines.append(f"  {'layout':<12}{'collective':>14}{'peak HBM':>14}"
+                 f"{'diags':>8}")
+    for name, m in rows:
+        lines.append(f"  {name:<12}{m['collective_bytes']:>14}"
+                     f"{m['hbm_peak']:>14}{m['diagnostics']:>8}")
+    return "\n".join(lines)
+
+
+def self_check():
+    """Violation strings for framework_lint's cross-check registry."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        plan, preset, replicated, _prog, _net, logits = build_plan(tp=2)
+    except Exception as e:  # noqa: BLE001 - a lint must not crash the gate
+        return [f"spmd_plan self-check failed to build/plan: {e!r}"]
+    problems = []
+    pm, bm = plan.predicted, _metrics(preset)
+    if pm["diagnostics"]:
+        problems.append("spmd_plan golden TP config: plan carries "
+                        f"{pm['diagnostics']} diagnostic(s)")
+    if pm["collective_bytes"] > bm["collective_bytes"]:
+        problems.append(
+            "spmd_plan golden TP config: planned collective bytes "
+            f"{pm['collective_bytes']} exceed the hand-written preset's "
+            f"{bm['collective_bytes']}")
+    if pm["hbm_peak"] > bm["hbm_peak"]:
+        problems.append(
+            "spmd_plan golden TP config: planned peak HBM "
+            f"{pm['hbm_peak']} exceeds the hand-written preset's "
+            f"{bm['hbm_peak']}")
+    megatron = {
+        "blocks.0.attn.qkv_proj.weight": P(None, "tp"),
+        "blocks.1.attn.out_proj.weight": P("tp", None),
+        "blocks.0.fc1.weight": P(None, "tp"),
+        "blocks.1.fc2.weight": P("tp", None),
+        "wte.weight": P("tp", None),
+    }
+    for name, want in megatron.items():
+        got = plan.spec_for(name, 2)
+        if got != want:
+            problems.append(
+                f"spmd_plan golden TP config: {name} planned as {got}, "
+                f"the Megatron layout is {want}")
+    ar = [c for c in plan.report.collectives if c.kind == "all_reduce"]
+    if len(ar) != 5 or any(c.axis != "tp" for c in ar):
+        problems.append(
+            "spmd_plan golden TP config: expected 2L+1=5 tp all-reduces, "
+            f"planner's layout implies {len(ar)}")
+    try:
+        plan2, _, _, _, _, _ = build_plan(tp=2, dp=2)
+    except Exception as e:  # noqa: BLE001
+        return problems + [f"spmd_plan dp x tp self-check crashed: {e!r}"]
+    ids_spec = tuple(plan2.data_specs.get("input_ids", P()))
+    if not ids_spec or ids_spec[0] != "dp":
+        problems.append(
+            "spmd_plan dp x tp config: input_ids not sharded on dp "
+            f"(got {ids_spec})")
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="auto-sharding planner (search PartitionSpec plans "
+                    "against the SPMD analyzer's cost model) for the GPT "
+                    "workload")
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--seq", type=int, default=16)
+    ap.add_argument("--beam", type=int, default=None,
+                    help="beam width (default FLAGS_spmd_plan_beam)")
+    ap.add_argument("--coll-weight", type=float, default=None,
+                    help="objective weight on collective bytes/step")
+    ap.add_argument("--hbm-weight", type=float, default=None,
+                    help="objective weight on peak per-device HBM")
+    ap.add_argument("--zero-dp", action="store_true",
+                    help="offer ZeRO-style dim-0 dp sharding candidates")
+    ap.add_argument("--json", action="store_true",
+                    help="stable JSON on stdout (CI consumption)")
+    args = ap.parse_args(argv)
+
+    plan, preset, replicated, _prog, _net, _logits = build_plan(
+        tp=args.tp, dp=args.dp, sp=args.sp, layers=args.layers,
+        hidden=args.hidden, heads=args.heads, vocab=args.vocab,
+        batch=args.batch, seq=args.seq, beam=args.beam,
+        coll_weight=args.coll_weight, hbm_weight=args.hbm_weight,
+        zero_dp=args.zero_dp)
+    payload = plan_json(plan, preset, replicated)
+    if args.json:
+        print(json.dumps(payload, sort_keys=True, indent=1))
+    else:
+        print(plan.render())
+        print(render_table(plan, preset, replicated))
+        print(f"search: {plan.evaluations} analyzer evaluations")
+    return 0 if payload["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
