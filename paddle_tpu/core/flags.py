@@ -92,6 +92,27 @@ define_flag("FLAGS_spmd_plan_coll_weight", 1.0,
 define_flag("FLAGS_spmd_plan_hbm_weight", 1.0,
             "planner objective weight on predicted peak per-device HBM "
             "bytes")
+define_flag("FLAGS_spmd_plan_pp_micro", 8,
+            "microbatch count the pipeline stage-cut planner prices a "
+            "step with (static/spmd_planner.plan_pipeline): bubble "
+            "fraction, ppermute wire bytes and per-tick hidden payload "
+            "all scale with it")
+define_flag("FLAGS_spmd_plan_pp_beam", 8,
+            "beam width of the stage-cut search over legal cut "
+            "boundaries (diagnostic-stratified, same machinery as the "
+            "SPMD layout beam)")
+define_flag("FLAGS_spmd_plan_pp_flops_weight", 1.0,
+            "stage-cut objective weight on the pipeline-full compute "
+            "proxy max(stage FLOPs) * num_micro (compute balance)")
+define_flag("FLAGS_spmd_plan_pp_wire_weight", 1.0,
+            "stage-cut objective weight on the ppermute wire bytes/step "
+            "(pipeline.schedule_collectives of the cut frontier)")
+define_flag("FLAGS_spmd_plan_pp_hbm_weight", 1.0,
+            "stage-cut objective weight on max per-stage peak HBM "
+            "(analyze_memory restricted to each stage's op range)")
+define_flag("FLAGS_spmd_plan_pp_bubble_weight", 1.0,
+            "stage-cut objective weight on the bubble cost "
+            "bubble_fraction * total FLOPs (idle compute)")
 define_flag("FLAGS_use_flash_attention", True,
             "route attention through the Pallas flash kernel on TPU "
             "(paddle_tpu.ops.pallas.flash_attention)")

@@ -47,8 +47,15 @@ class DistributedStrategy:
         self.auto_shard = False
         self.auto_shard_configs = {}
         self.pipeline = False
+        # The planner writes searched stage assignments into this same
+        # knob surface (static/spmd_planner.ShardingPlan.as_strategy
+        # when the plan carries pipeline cuts): "num_virtual" (chunks
+        # per rank, interleaved 1F1B when > 1), "pp_degree" and
+        # "stage_op_ranges" (the planned per-stage op ranges) join the
+        # reference keys; the Executor resolves them onto the Program
+        # as _pipeline_stages before the VERIFY_SPMD hook runs.
         self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1,
-                                 "schedule_mode": "1F1B"}
+                                 "schedule_mode": "1F1B", "num_virtual": 1}
         self.tensor_parallel = False
         self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
         self.gradient_merge = False

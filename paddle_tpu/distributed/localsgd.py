@@ -110,7 +110,8 @@ class LocalSGD:
                     counter[None])
 
         pspec = jax.tree_util.tree_map(lambda _: P(self.axis), self.params)
-        fn = jax.jit(jax.shard_map(
+        from . import mesh as _mesh_mod
+        fn = jax.jit(_mesh_mod.shard_map(
             spmd, mesh=self.mesh,
             in_specs=(pspec, P(self.axis), P(self.axis)),
             out_specs=(P(self.axis), pspec, P(self.axis))))

@@ -23,7 +23,29 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["init_mesh", "init_hybrid_mesh", "get_mesh", "set_mesh",
            "reset_mesh", "mesh_axis_size", "in_spmd_region",
-           "named_sharding", "MeshGuard", "auto_mesh"]
+           "named_sharding", "MeshGuard", "auto_mesh", "shard_map"]
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, check=False):
+    """Version-portable `shard_map`: `jax.shard_map` where it exists
+    (newer jax; `check_vma=`), `jax.experimental.shard_map.shard_map`
+    otherwise (`check_rep=`). The replication check defaults OFF — the
+    pipeline/MoE SPMD programs here intermix psum/ppermute/all_to_all in
+    ways the checker's older releases reject spuriously."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        try:
+            return fn(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=check)
+        except TypeError:
+            return fn(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+    try:
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check)
+    except TypeError:
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
 
 _lock = threading.Lock()
 _meshes: Dict[str, Mesh] = {}
@@ -156,6 +178,20 @@ def auto_mesh() -> Mesh:
 
 
 def mesh_axis_size(axis: str, name: str = None) -> int:
+    """Size of a mesh axis. Inside an SPMD region (shard_map trace)
+    the BOUND axis size is authoritative — the registry may hold a
+    different default mesh (e.g. a test registered `{"dp": 8}` as
+    "default" while the pipeline runs under a named `{"pp": 4}` mesh;
+    reading the registry there silently degraded the pipeline to a
+    single stage). Falls back to the registered mesh when the axis is
+    not bound in the current trace."""
+    try:
+        from jax._src.core import get_axis_env
+        env = get_axis_env()
+        if axis in tuple(env.axis_names()):
+            return int(env.axis_size(axis))
+    except Exception:
+        pass  # private accessor moved / axis unbound: registry fallback
     m = get_mesh(name)
     if m is None or axis not in m.axis_names:
         return 1

@@ -24,7 +24,15 @@ def switch_route(gate_logits, num_experts, capacity, k=1):
 
     dispatch: [tokens, experts, capacity] one-hot
     combine:  [tokens, experts, capacity] gate-weighted
-    """
+
+    Tokens routed past an expert's `capacity` are DROPPED (their
+    dispatch row is all-zero — the standard Switch overflow semantics).
+    That used to be silent; outside a jit trace the drop count now bumps
+    the `moe.dropped_tokens` monitor counter, so a mis-sized
+    capacity_factor shows up on the dashboard instead of as a quiet
+    quality regression. (Eager-mode calls pay one host sync for the
+    count; traced/jitted calls pay nothing — the accounting is skipped
+    entirely under tracing.)"""
     probs = jax.nn.softmax(gate_logits, axis=-1)            # [T, E]
     expert = jnp.argmax(probs, axis=-1)                     # [T]
     gate = jnp.max(probs, axis=-1)                          # [T]
@@ -32,6 +40,11 @@ def switch_route(gate_logits, num_experts, capacity, k=1):
     # position of each token within its expert's queue
     pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0         # [T, E]
     keep = (pos < capacity) & (onehot > 0)
+    if not isinstance(keep, jax.core.Tracer):
+        n = int(jnp.sum(onehot > 0) - jnp.sum(keep))
+        if n:
+            from ..core import monitor
+            monitor.stat_add("moe.dropped_tokens", n)
     pos_cap = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
     dispatch = keep[..., None] & (jax.nn.one_hot(pos_cap, capacity) > 0)
     combine = dispatch.astype(probs.dtype) * gate[:, None, None]

@@ -152,7 +152,17 @@ def schedule_ticks(num_micro: int, num_stages: int, schedule: str = "gpipe",
     """Chunk-time ticks a schedule takes (the step-time accounting the
     reference leaves implicit in SectionWorker): gpipe/1f1b run M+n-1
     ticks of full per-rank depth (= v chunk-times each); interleaved runs
-    v*M + n - 1 single-chunk ticks."""
+    v*M + n - 1 single-chunk ticks.
+
+    Degenerate shapes price sanely instead of going negative: a
+    single-stage "pipeline" (n=1) is just M serial microbatches (v*M
+    ticks), and M < n still runs M+n-1 ticks (mostly bubble — the cost
+    model must SEE that, not crash)."""
+    num_micro = max(int(num_micro), 0)
+    num_stages = max(int(num_stages), 1)
+    num_virtual = max(int(num_virtual), 1)
+    if num_micro == 0:
+        return 0
     if schedule == "interleaved":
         return num_virtual * num_micro + num_stages - 1
     return num_virtual * (num_micro + num_stages - 1)
@@ -181,9 +191,23 @@ def pipeline_loss(stage_fn, loss_fn, x_micro, labels_micro, axis="pp",
     return lax.psum(total, axis) / M
 
 
-def bubble_fraction(num_micro: int, num_stages: int) -> float:
+def bubble_fraction(num_micro: int, num_stages: int,
+                    schedule: str = "gpipe", num_virtual: int = 1) -> float:
     """Pipeline bubble overhead (n-1)/(M+n-1) — the schedule-quality
-    accounting the reference leaves implicit in SectionWorker."""
+    accounting the reference leaves implicit in SectionWorker. The
+    interleaved schedule's finer chunks shrink it to (n-1)/(vM+n-1).
+
+    Degenerate pipelines price as ZERO bubble: one stage never idles,
+    and zero microbatches have no schedule to be idle in (guards the
+    divide-by-zero a naive (n-1)/(M+n-1) hits at M=0, n=1)."""
+    num_micro = max(int(num_micro), 0)
+    num_stages = max(int(num_stages), 1)
+    num_virtual = max(int(num_virtual), 1)
+    if num_stages <= 1 or num_micro == 0:
+        return 0.0
+    if schedule == "interleaved":
+        return (num_stages - 1) / (num_virtual * num_micro
+                                   + num_stages - 1)
     return (num_stages - 1) / (num_micro + num_stages - 1)
 
 
@@ -196,7 +220,13 @@ def schedule_collectives(num_micro: int, num_stages: int,
     cost of a step is ticks x hidden_bytes — the quantity the analyzer's
     collective table and tools/spmd_lint.py report next to the
     matmul-implied all-reduces. (The forward numbers; AD mirrors each
-    ppermute in reverse, doubling the wire bytes for training.)"""
+    ppermute in reverse, doubling the wire bytes for training.)
+
+    A single-stage pipeline has no ring to permute around — it prices
+    as ZERO ppermutes, not `ticks` no-op sends."""
+    if max(int(num_stages), 1) <= 1:
+        return {"kind": "ppermute", "axis": axis, "count": 0,
+                "bytes_per_tick": int(hidden_bytes), "total_bytes": 0}
     ticks = schedule_ticks(num_micro, num_stages, schedule, num_virtual)
     return {"kind": "ppermute", "axis": axis, "count": ticks,
             "bytes_per_tick": int(hidden_bytes),

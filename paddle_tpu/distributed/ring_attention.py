@@ -235,8 +235,9 @@ def sequence_parallel_attention(q, k, v, mesh=None, axis="sp", causal=False,
     def local(ql, kl, vl):
         return fn(ql, kl, vl, axis, causal, scale)
 
-    out = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                        out_specs=spec)(*raw)
+    out = mesh_mod.shard_map(local, mesh=mesh,
+                             in_specs=(spec, spec, spec),
+                             out_specs=spec)(*raw)
     if isinstance(q, Tensor):
         return Tensor(out, stop_gradient=True, _internal=True)
     return out

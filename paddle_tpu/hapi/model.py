@@ -245,9 +245,21 @@ class _CompiledEngine:
             if scaler is not None else None
 
         def data_sh(example):  # scalar leaves (rank 0) cannot ride P('dp')
-            return jax.tree_util.tree_map(
-                lambda a: plan["batch"] if np.ndim(a) >= 1
-                else plan["repl"], tuple(example))
+            def leaf_sh(a):
+                if np.ndim(a) < 1:
+                    return plan["repl"]
+                dp = plan["mesh"].shape.get("dp", 1)
+                if dp > 1 and np.shape(a)[0] % dp:
+                    # a batch the dp axis cannot divide (e.g. a leaked
+                    # wider-than-batch default mesh) degrades to
+                    # replicated input, same contract as
+                    # sharding._validate_divisible — loudly, not a
+                    # pjit divisibility crash
+                    from ..core import monitor as _monitor
+                    _monitor.stat_add("sharding.nondivisible_fallback")
+                    return plan["repl"]
+                return plan["batch"]
+            return jax.tree_util.tree_map(leaf_sh, tuple(example))
 
         return jax.jit(
             step,
@@ -319,12 +331,12 @@ class _CompiledEngine:
         bspec = jax.tree_util.tree_map(
             lambda _: P(), {n: 0 for n, _ in
                             self.model.network.named_buffers()})
-        return jax.jit(jax.shard_map(
+        from ..distributed import mesh as _mesh_mod
+        return jax.jit(_mesh_mod.shard_map(
             spmd, mesh=mesh,
             in_specs=(pspec, bspec, sspec, P(), P(), P(), P("dp"),
                       P("dp"), P("dp")),
-            out_specs=(P(), P("dp"), bspec, pspec, sspec, P("dp")),
-            check_vma=False))
+            out_specs=(P(), P("dp"), bspec, pspec, sspec, P("dp"))))
 
     def _train_batch_localsgd(self, cfg, raw_in, raw_lab):
         import numpy as np

@@ -16,7 +16,7 @@ from . import amp  # noqa: F401
 from .executor import (BuildStrategy, CompiledProgram, ExecutionStrategy,  # noqa: F401
                        Executor)
 from .pipeline_runner import (FetchHandle, PipelineRunner,  # noqa: F401
-                              PipelineStepError)
+                              PipelineStepError, StagedPipelineRunner)
 from .program import (Program, Variable, StaticParam, default_main_program,  # noqa: F401
                       default_startup_program, disable_static_,
                       enable_static_, global_scope, in_static_mode,
@@ -28,7 +28,8 @@ from .spmd_analyzer import (Collective, SpmdDiagnostic,  # noqa: F401
                             analyze_program, maybe_verify_spmd,
                             register_spmd_rule, set_verify_spmd,
                             verify_spmd_enabled)
-from .spmd_planner import (PlanRule, ShardingPlan,  # noqa: F401
+from .spmd_planner import (PipelinePlan, PlanRule, ShardingPlan,  # noqa: F401
+                           StageCost, legal_cut_points, plan_pipeline,
                            plan_program, resolve_auto_shard)
 from .verifier import ProgramVerifyError, verify_program  # noqa: F401
 
@@ -45,8 +46,10 @@ __all__ = ["data", "InputSpec", "Program", "Variable", "Executor",
            "SpmdReport", "SpmdDiagnostic", "Collective",
            "register_spmd_rule", "set_verify_spmd", "verify_spmd_enabled",
            "maybe_verify_spmd", "ShardingPlan", "PlanRule",
-           "plan_program", "resolve_auto_shard", "PipelineRunner",
-           "FetchHandle", "PipelineStepError"]
+           "plan_program", "resolve_auto_shard", "PipelinePlan",
+           "StageCost", "plan_pipeline", "legal_cut_points",
+           "PipelineRunner", "FetchHandle", "PipelineStepError",
+           "StagedPipelineRunner"]
 
 
 def data(name, shape, dtype="float32", lod_level=0):

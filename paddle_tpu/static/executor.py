@@ -211,9 +211,16 @@ class Executor:
         from ..core import trace as _trace
         # strategy.auto_shard (fleet.distributed_optimizer): derive the
         # PartitionSpec plan for this program at compile, BEFORE the
-        # verify/estimate hooks below read program.spmd_*_specs
+        # verify/estimate hooks below read program.spmd_*_specs. A plan
+        # carrying pipeline stage cuts also pins the stage assignment
+        # (program._pipeline_stages) here — stages resolve before the
+        # VERIFY_SPMD hook, so hook findings and the stage table always
+        # describe the same plan.
         if getattr(program, "_auto_shard", None) is not None \
-                and getattr(program, "spmd_param_specs", None) is None:
+                and (getattr(program, "spmd_param_specs", None) is None
+                     or getattr(program, "_pipeline_stages", None) is None
+                     and getattr((program._auto_shard or {}).get("plan"),
+                                 "pipeline", None) is not None):
             from .spmd_planner import resolve_auto_shard
             resolve_auto_shard(program)
         # PADDLE_TPU_VERIFY_SPMD: sharding findings (unbound axis,

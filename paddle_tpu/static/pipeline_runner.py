@@ -56,7 +56,7 @@ import numpy as np
 from ..core import trace as _trace
 
 __all__ = ["PipelineRunner", "FetchHandle", "PipelineStepError",
-           "InflightDriver"]
+           "InflightDriver", "StagedPipelineRunner"]
 
 # Flow-id namespace: each runner gets a disjoint block so step flows from
 # two runners in one process can't alias in the Chrome trace. Step idx
@@ -306,17 +306,185 @@ class InflightDriver(_InflightWindow):
         self._verify_through(self._next_index)
 
 
+class StagedPipelineRunner(InflightDriver):
+    """Executes a PLANNED pipeline partition (`static/spmd_planner.
+    plan_pipeline` -> `PipelinePlan`) as one SPMD program per train
+    step: the plan's global stages become per-rank chunks (interleaved
+    1F1B convention — global stage g is chunk g//n on rank g%n), each
+    step runs the plan's `num_micro` microbatches through
+    `distributed/pipeline.pipeline_loss` (schedule "1f1b" for v=1,
+    "interleaved" for v>1) inside `shard_map` over the pp (and
+    optionally dp) mesh axes, and successive steps dispatch through the
+    inherited bounded in-flight window — the PR 5 microbatch engine now
+    driving planned stage chunks.
+
+    The model is supplied as homogeneous UNITS (hidden -> hidden),
+    one per plan segment (`plan.n_segments` — the regions between the
+    planner's legal cut boundaries): `unit_apply(h, unit_params) -> h`
+    plus a list of per-unit parameter pytrees with identical structure
+    and leaf shapes. Stages owning fewer units than the deepest stage
+    are padded with masked no-op slots, so every rank traces the SAME
+    program (the single-program SPMD invariant pipeline.py documents).
+
+    Training is SGD on the stacked params (`learning_rate`); `step(x,
+    y)` returns a lazy loss FetchHandle, `unit_params()` unstacks the
+    live params back into plan-segment order, `sync()` materializes all
+    in-flight steps (PipelineStepError semantics inherited)."""
+
+    def __init__(self, plan, unit_apply, unit_params, loss_fn, mesh=None,
+                 learning_rate=0.1, dp_axis="dp", max_inflight=None):
+        super().__init__(name="pipeline/staged",
+                         max_inflight=max_inflight)
+        from jax.sharding import PartitionSpec as P
+
+        from ..distributed import mesh as mesh_mod
+        from ..distributed import pipeline as pipe
+
+        if mesh is None:
+            mesh = mesh_mod.get_mesh()
+        if mesh is None or plan.axis not in mesh.axis_names:
+            have = None if mesh is None else tuple(mesh.axis_names)
+            raise ValueError(
+                "StagedPipelineRunner needs a mesh with the plan's "
+                f"'{plan.axis}' axis (got axes {have}) — a leaked "
+                "default mesh does not qualify")
+        n, v = plan.num_stages, plan.num_virtual
+        segs = plan.stage_segments()
+        if len(unit_params) != plan.n_segments:
+            raise ValueError(
+                f"plan has {plan.n_segments} segments but "
+                f"{len(unit_params)} unit param pytrees were given")
+        u_max = max((len(s) for s in segs), default=1) or 1
+        self._plan = plan
+        self._mesh = mesh
+        self._lr = float(learning_rate)
+        self._M = plan.num_micro
+        self._axis = plan.axis
+        self._dp = dp_axis if dp_axis in mesh.axis_names else None
+        self._seg_pos = {}  # segment -> (rank, chunk, unit slot)
+
+        # pad slots carry a COPY of real params, not zeros: the masked
+        # where-branch still evaluates unit_apply on them, and a
+        # singular input (division by a zero scale, w/||w||) would
+        # NaN-poison the shared cotangent through NaN * 0
+        pad = unit_params[0]
+        grid = [[[pad] * u_max for _ in range(v)] for _ in range(n)]
+        mask = np.zeros((n, v, u_max), np.float32)
+        for g, seg_list in enumerate(segs):
+            r, c = g % n, g // n
+            for u, seg in enumerate(seg_list):
+                grid[r][c][u] = unit_params[seg]
+                mask[r, c, u] = 1.0
+                self._seg_pos[seg] = (r, c, u)
+        # leaves -> [n, v, u_max, ...]
+        self._w = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves).reshape(
+                (n, v, u_max) + leaves[0].shape),
+            *[grid[r][c][u] for r in range(n) for c in range(v)
+              for u in range(u_max)])
+        self._mask = jnp.asarray(mask)
+
+        schedule = "interleaved" if v > 1 else \
+            (plan.schedule if plan.schedule in ("gpipe", "1f1b")
+             else "1f1b")
+        axis = self._axis
+        dp = self._dp
+
+        def spmd(wr, mr, xm, ym):
+            # wr leaves [1, v, u_max, ...] (this rank's chunks)
+            def chunk_fn(c):
+                def f(h):
+                    for u in range(u_max):
+                        p_u = jax.tree_util.tree_map(
+                            lambda leaf: leaf[0, c, u], wr)
+                        h = jnp.where(mr[0, c, u] > 0,
+                                      unit_apply(h, p_u), h)
+                    return h
+                return f
+            fns = [chunk_fn(c) for c in range(v)]
+            loss = pipe.pipeline_loss(
+                fns if schedule == "interleaved" else fns[0],
+                loss_fn, xm, ym, axis=axis, schedule=schedule)
+            if dp is not None:
+                loss = jax.lax.pmean(loss, dp)
+            return loss
+
+        in_x = P(None, dp) if dp is not None else P()
+
+        def outer(w, m, x, y):
+            return mesh_mod.shard_map(
+                spmd, mesh=mesh, in_specs=(P(axis), P(axis), in_x, in_x),
+                out_specs=P())(w, m, x, y).mean()
+
+        lr = self._lr
+
+        def train_step(w, m, x, y):
+            loss, g = jax.value_and_grad(outer)(w, m, x, y)
+            new_w = jax.tree_util.tree_map(lambda p, gg: p - lr * gg,
+                                           w, g)
+            return new_w, loss
+
+        self._jit = jax.jit(train_step, donate_argnums=(0,))
+
+    @property
+    def plan(self):
+        return self._plan
+
+    def step(self, x, y):
+        """Dispatch one pipelined train step over the plan's num_micro
+        microbatches of (x, y); returns a lazy loss FetchHandle."""
+        from ..distributed.pipeline import micro_batch
+        xm = micro_batch(jnp.asarray(x), self._M)
+        ym = micro_batch(jnp.asarray(y), self._M)
+        w, mask = self._w, self._mask
+
+        def thunk():
+            new_w, loss = self._jit(w, mask, xm, ym)
+            return new_w, [loss]
+
+        carry, handles = self.submit(
+            thunk, stages=self._plan.num_stages,
+            num_virtual=self._plan.num_virtual, micro=self._M)
+        if carry is not None:
+            self._w = carry
+        return handles[0]
+
+    def unit_params(self):
+        """The live parameters, unstacked back into plan-segment order
+        (materializes in-flight work first)."""
+        self.sync()
+        out = []
+        for seg in range(self._plan.n_segments):
+            r, c, u = self._seg_pos[seg]
+            out.append(jax.tree_util.tree_map(
+                lambda leaf: leaf[r, c, u], self._w))
+        return out
+
+
 class PipelineRunner(_InflightWindow):
     """Drives a static Program's compiled step with in-flight steps and a
     device-resident carry. Use as a context manager; `sync()` (or exit)
-    materializes all in-flight work and writes the Scope/slots back."""
+    materializes all in-flight work and writes the Scope/slots back.
+
+    `stage_plan` (a `spmd_planner.PipelinePlan`) makes the runner
+    stage-aware: the plan rides on dispatch spans and the
+    `executor/pipeline_stages` gauge, so a planned-pipeline program's
+    trace names its partition. Execution of the planned stages
+    themselves is `StagedPipelineRunner`'s job (one SPMD program per
+    step); this runner remains the host-side step driver."""
 
     def __init__(self, executor, program, fetch_list=None, scope=None,
-                 max_inflight=None, scan_steps=None):
+                 max_inflight=None, scan_steps=None, stage_plan=None):
         from ..core import flags as _flags
         from .executor import CompiledProgram
         from .program import default_main_program, global_scope
         self._exe = executor
+        self.stage_plan = stage_plan
+        if stage_plan is not None:
+            from ..core import monitor as _monitor
+            _monitor.stat_set("executor/pipeline_stages",
+                              stage_plan.num_stages
+                              * stage_plan.num_virtual)
         self._data_parallel = False
         if isinstance(program, CompiledProgram):
             self._data_parallel = program.data_parallel
@@ -415,6 +583,9 @@ class PipelineRunner(_InflightWindow):
             return self._dead_handles(1)[0]
         t0 = time.perf_counter()
         sp = _trace.begin("pipeline/dispatch", parent=self._trace_ctx)
+        if self.stage_plan is not None:
+            sp.attrs["pipeline_stages"] = self.stage_plan.num_stages \
+                * self.stage_plan.num_virtual
         pf = self._prefetch_flow
         if pf is not None:        # close the prefetch->dispatch handoff
             self._prefetch_flow = None

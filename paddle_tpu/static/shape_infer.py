@@ -19,7 +19,7 @@ the Executor (FLAGS_log_memory_estimate) and tools/pp_schedule_report.py.
 from __future__ import annotations
 
 import numpy as np
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -218,7 +218,8 @@ def infer_program(program: Program, check: bool = True,
 
 def analyze_memory(program: Program,
                    env: Optional[dict] = None,
-                   shard_divisors: Optional[Dict[int, int]] = None) -> dict:
+                   shard_divisors: Optional[Dict[int, int]] = None,
+                   op_range: Optional[Tuple[int, int]] = None) -> dict:
     """Estimate the lowered step's peak residency from inferred avals.
 
     Liveness at the Program level (the reference's
@@ -232,6 +233,14 @@ def analyze_memory(program: Program,
     of its sharded dims' mesh-axis sizes (supplied by
     static/spmd_analyzer.py from the propagated PartitionSpecs).
 
+    op_range=(lo, hi) restricts the estimate to the op slice [lo, hi) —
+    the per-STAGE residency a pipeline-stage cut would give that slice
+    (static/spmd_planner.plan_pipeline prices candidate cuts with it):
+    only persistables/feeds the slice actually reads count as resident,
+    a var defined before `lo` but read inside is a stage input (resident
+    throughout the slice), and a var defined inside but read after `hi`
+    is the stage's outbound frontier (pinned to the end of the slice).
+
     Returns {"peak_bytes", "param_bytes", "feed_bytes",
     "activation_peak_bytes", "timeline": [(op_name, live_bytes)],
     "peak_op"}; a pure estimate — XLA's buffer assignment (fusion,
@@ -244,21 +253,9 @@ def analyze_memory(program: Program,
     def _nb(vid, aval):
         return _nbytes(aval) // max(int(divs.get(vid, 1)), 1)
 
-    param_bytes = 0
-    for scope_name, vid in program.persist_ids.items():
-        pv = program.persistable_vars.get(scope_name)
-        if pv is not None:
-            param_bytes += _nb(vid, pv.aval)
-    feed_bytes = sum(_nb(v.var_id, v.aval)
-                     for v in program.data_vars.values())
-
     n = len(program.ops)
-    roots = set(program.state_writes.values())
-    if program.backward_section is not None:
-        loss, pairs = program.backward_section
-        roots.add(loss.var_id)
-    for v in getattr(program, "_jit_fetch_vars", []) or []:
-        roots.add(v.var_id)
+    lo, hi = (0, n) if op_range is None else op_range
+    lo, hi = max(0, int(lo)), min(n, int(hi))
 
     last_use: Dict[int, int] = {}
     defined_at: Dict[int, int] = {}
@@ -268,15 +265,57 @@ def analyze_memory(program: Program,
                 last_use[x.var_id] = i
         for oid in op.out_ids:
             defined_at[oid] = i
+
+    used_in_range = None
+    if op_range is not None:
+        used_in_range = set()
+        for op in program.ops[lo:hi]:
+            for x in op.flat:
+                if isinstance(x, _Ref):
+                    used_in_range.add(x.var_id)
+
+    param_bytes = 0
+    param_ids = set()
+    for scope_name, vid in program.persist_ids.items():
+        pv = program.persistable_vars.get(scope_name)
+        if pv is not None and (used_in_range is None
+                               or vid in used_in_range):
+            param_bytes += _nb(vid, pv.aval)
+            param_ids.add(vid)
+    feed_bytes = 0
+    feed_ids = set()
+    for v in program.data_vars.values():
+        if used_in_range is None or v.var_id in used_in_range:
+            feed_bytes += _nb(v.var_id, v.aval)
+            feed_ids.add(v.var_id)
+    if used_in_range is not None:
+        # inbound frontier: defined before the slice, read inside —
+        # resident for the whole stage like a feed
+        for vid in used_in_range:
+            if vid in param_ids or vid in feed_ids:
+                continue
+            if defined_at.get(vid, lo) < lo and vid in env:
+                feed_bytes += _nb(vid, env[vid])
+                feed_ids.add(vid)
+
+    roots = set(program.state_writes.values())
+    if program.backward_section is not None:
+        loss, pairs = program.backward_section
+        roots.add(loss.var_id)
+    for v in getattr(program, "_jit_fetch_vars", []) or []:
+        roots.add(v.var_id)
     for vid in roots:
         last_use[vid] = n  # pinned to the end of the step
+    if op_range is not None:
+        roots = {vid for vid in roots if lo <= defined_at.get(vid, -1) < hi}
 
     timeline = []
     peak = param_bytes + feed_bytes
     peak_op = None
     live_bytes = 0
     live_now: Dict[int, int] = {}
-    for i, op in enumerate(program.ops):
+    for i in range(lo, hi):
+        op = program.ops[i]
         for oid in op.out_ids:
             if oid in env and last_use.get(oid, -1) >= i:
                 b = _nb(oid, env[oid])
@@ -287,7 +326,9 @@ def analyze_memory(program: Program,
         if total > peak:
             peak, peak_op = total, (i, op.name)
         # free vars whose last reader this op was (outputs freed above
-        # only after their own last use passes)
+        # only after their own last use passes); under op_range, a var
+        # still read past `hi` is the outbound frontier and stays live
+        # to the end of the slice
         for vid in [v for v, last in list(live_now.items())
                     if last_use.get(v, -1) <= i and v not in roots]:
             live_bytes -= live_now.pop(vid)

@@ -46,8 +46,9 @@ from jax.sharding import PartitionSpec as P
 from .program import Program, _Ref
 
 __all__ = ["SpmdLintError", "SpmdDiagnostic", "Collective", "SpmdReport",
-           "analyze_program", "analyze_params", "register_spmd_rule",
-           "SPMD_RULES", "DIAGNOSTIC_CODES", "verify_spmd_enabled",
+           "analyze_program", "analyze_params", "analyze_flops",
+           "register_spmd_rule", "register_flop_rule", "SPMD_RULES",
+           "FLOP_RULES", "DIAGNOSTIC_CODES", "verify_spmd_enabled",
            "set_verify_spmd", "maybe_verify_spmd"]
 
 # Every named finding the analyzer can produce. Each code has a dedicated
@@ -903,6 +904,95 @@ def _fused_ce_rule(ctx, ins, kw, out_avals, var):
     return [out_spec]
 
 
+def _moe_capacity(xv_aval, kw, e_total) -> int:
+    """The capacity moe.MoELayer computes at run time, re-derived from
+    the recorded avals: int(cap_factor * tokens / num_experts) + 1."""
+    tokens = 1
+    for s in xv_aval.shape[:-1]:
+        tokens *= int(s)
+    cap_factor = float(_lit(kw.get("cap_factor", 1.25), 1.25))
+    return int(cap_factor * tokens / max(int(e_total), 1)) + 1
+
+
+@register_spmd_rule("moe_layer")
+def _moe_rule(ctx, ins, kw, out_avals, var):
+    """Expert parallelism (distributed/moe.py MoELayer): the stacked
+    expert weights ([E, d, h] / [E, h] — dim 0 is the expert dim) may
+    shard over the layer's `axis` kwarg (conventionally 'ep'); routed
+    tokens then move through TWO all-to-alls (dispatch and combine) of
+    the [E, capacity, d] dispatch tensor. Tokens/output keep the input's
+    sharding. Expert weights disagreeing on the expert-dim axis, or an
+    expert axis that also shards the tokens, are conflicts (reshard)."""
+    out_aval = out_avals[0]
+    if len(ins) < 6 or not isinstance(ins[0], _AV) or ins[0].aval is None:
+        return [_repl(out_aval)]
+    xv, gate_w = ins[0], ins[1]
+    experts = [v for v in ins[2:6] if isinstance(v, _AV)
+               and v.aval is not None]
+    x_spec = tuple(xv.spec)
+    token_axes = {ax for e in x_spec for ax in e}
+
+    # the expert-dim sharding all four stacked weights must agree on
+    ep_ent: tuple = ()
+    for w in experts:
+        ent = w.spec[0] if w.spec else ()
+        if ent and not ep_ent:
+            ep_ent = ent
+        elif ent and ent != ep_ent:
+            ctx.diag(
+                "reshard",
+                f"moe expert weights disagree on the expert-dim sharding "
+                f"({_spec_str((ep_ent,))} vs {_spec_str((ent,))}) — the "
+                "divergent weight is implicitly all-gathered", var=var,
+                axis=",".join(ent))
+            ctx.collective("all_gather", ent,
+                           ctx.payload(w.aval, w.spec, exclude=ent),
+                           var=var, aval=w.aval)
+    if ep_ent and any(ax in token_axes for ax in ep_ent):
+        drop = tuple(ax for ax in ep_ent if ax in token_axes)
+        ctx.diag(
+            "reshard",
+            f"moe expert axis {','.join(drop)} also shards the tokens — "
+            "the all-to-all dispatch cannot route across it; the expert "
+            "stacks are implicitly all-gathered", var=var,
+            axis=",".join(drop))
+        for w in experts:
+            if w.spec and w.spec[0]:
+                ctx.collective("all_gather", drop,
+                               ctx.payload(w.aval, w.spec, exclude=drop),
+                               var=var, aval=w.aval)
+        ep_ent = ()
+    if isinstance(gate_w, _AV) and gate_w.aval is not None \
+            and any(gate_w.spec):
+        gent = tuple(ax for e in gate_w.spec for ax in e)
+        ctx.diag(
+            "reshard",
+            "moe gate weight is sharded — the router runs replicated, so "
+            "the gate is implicitly all-gathered", var=var,
+            axis=",".join(gent))
+        ctx.collective("all_gather", gent,
+                       ctx.payload(gate_w.aval, gate_w.spec, exclude=gent),
+                       var=var, aval=gate_w.aval)
+
+    if ep_ent:
+        # dispatch + combine: each device exchanges its slice of the
+        # [E, capacity, d] routed-token tensor with every peer on the
+        # expert axis — per-device wire bytes = tensor * (ep-1)/ep
+        e_total = int(_lit(kw.get("e_total", 0), 0)) \
+            or int(experts[0].aval.shape[0])
+        cap = _moe_capacity(xv.aval, kw, e_total)
+        d_model = int(xv.aval.shape[-1])
+        payload = jax.ShapeDtypeStruct((e_total, cap, d_model),
+                                       xv.aval.dtype)
+        ep = ctx.div(ep_ent)
+        wire = (_nbytes(payload) * max(ep - 1, 0)) // max(ep, 1)
+        for _ in ("dispatch", "combine"):
+            ctx.collective("all_to_all", ep_ent, wire, var=var,
+                           aval=payload)
+    out_spec = (x_spec + ((),) * len(out_aval.shape))[:len(out_aval.shape)]
+    return [out_spec]
+
+
 def _default_rule(ctx, ins, kw, out_avals, var):
     """Shape-matching pass-through: each output adopts the spec of the
     first input with the same shape (covers unary/activation/cast/dropout
@@ -924,6 +1014,144 @@ def _default_rule(ctx, ins, kw, out_avals, var):
                 ctx.report.unknown_ops.add(ctx.op_name)
         outs.append(pick)
     return outs
+
+
+# ---------------------------------------------------------------------------
+# per-op FLOPs model — the compute half of the cost model. Closed forms
+# over recorded avals (no tracing), the way analyze_memory estimates
+# bytes: exact for the matmul-class ops that dominate, nelems-scale for
+# everything else. Forward-pass numbers; training backward is a uniform
+# ~2x on the same ops, so stage-BALANCE (what the pipeline planner
+# optimizes) is unchanged by the factor.
+# ---------------------------------------------------------------------------
+
+FLOP_RULES: Dict[str, Any] = {}
+
+
+def register_flop_rule(*names):
+    """Register a FLOPs rule: fn(in_avals, kw, out_avals) -> float.
+    `in_avals` are the op's positional inputs (avals or raw literals),
+    `kw` the kwargs dict with tensor leaves as avals."""
+    def deco(fn):
+        for n in names:
+            FLOP_RULES[n] = fn
+        return fn
+    return deco
+
+
+def _numel(aval) -> int:
+    if aval is None or not hasattr(aval, "shape"):
+        return 0
+    n = 1
+    for s in aval.shape:
+        n *= int(s)
+    return n
+
+
+def _is_shaped(x) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+@register_flop_rule("matmul")
+def _matmul_flops(ins, kw, out_avals):
+    x = ins[0] if ins and _is_shaped(ins[0]) else None
+    if x is None or not x.shape:
+        return float(_numel(out_avals[0]))
+    k = x.shape[-2] if (kw.get("transpose_x", False) and len(x.shape) > 1) \
+        else x.shape[-1]
+    return 2.0 * _numel(out_avals[0]) * int(k)
+
+
+@register_flop_rule("sdpa")
+def _sdpa_flops(ins, kw, out_avals):
+    q = ins[0] if ins and _is_shaped(ins[0]) else None
+    k = ins[1] if len(ins) > 1 and _is_shaped(ins[1]) else None
+    if q is None:
+        return float(_numel(out_avals[0]))
+    s_kv = int(k.shape[-2]) if k is not None and len(k.shape) >= 2 \
+        else int(q.shape[-2])
+    return 4.0 * _numel(q) * s_kv  # QK^T + AV, 2 flops/MAC each
+
+
+@register_flop_rule("fused_ce_op", "ce_head_fallback")
+def _ce_flops(ins, kw, out_avals):
+    hidden = ins[0] if ins and _is_shaped(ins[0]) else None
+    w = ins[1] if len(ins) > 1 and _is_shaped(ins[1]) else None
+    if hidden is None or w is None:
+        return float(_numel(out_avals[0]))
+    rows = _numel(hidden) // max(int(hidden.shape[-1]), 1)
+    vocab = int(w.shape[0])
+    return 2.0 * rows * int(hidden.shape[-1]) * vocab
+
+
+@register_flop_rule("embedding")
+def _embedding_flops(ins, kw, out_avals):
+    return float(_numel(out_avals[0]))  # a gather: ~1 op per element
+
+
+@register_flop_rule("moe_layer")
+def _moe_flops(ins, kw, out_avals):
+    xv = ins[0] if ins and _is_shaped(ins[0]) else None
+    w_up = ins[2] if len(ins) > 2 and _is_shaped(ins[2]) else None
+    if xv is None or w_up is None:
+        return float(_numel(out_avals[0]))
+    d = int(xv.shape[-1])
+    tokens = _numel(xv) // max(d, 1)
+    e_total = int(_lit(kw.get("e_total", 0), 0)) or int(w_up.shape[0])
+    h = int(w_up.shape[-1])
+    cap = _moe_capacity(xv, kw, e_total)
+    gate = 2.0 * tokens * d * e_total
+    route = 2.0 * 2.0 * tokens * e_total * cap * d  # dispatch + combine
+    ffn = 2.0 * 2.0 * e_total * cap * d * h         # up + down
+    return gate + route + ffn
+
+
+def analyze_flops(program: Program) -> dict:
+    """Per-top-level-op forward FLOPs from the recorded avals.
+
+    Returns {"per_op": [float, one per program.ops entry], "total"}.
+    Ops without a dedicated rule price at the element count of their
+    largest operand/output (the elementwise/normalization scale); the
+    matmul-class rules above carry the balance signal the pipeline
+    stage-cut planner (static/spmd_planner.plan_pipeline) optimizes.
+    """
+    import jax.tree_util as jtu
+
+    env: Dict[int, Any] = {}
+    for v in program.data_vars.values():
+        env[v.var_id] = v.aval
+    for scope_name, vid in program.persist_ids.items():
+        pv = program.persistable_vars.get(scope_name)
+        if pv is not None:
+            env[vid] = pv.aval
+
+    per_op: List[float] = []
+    for op in program.ops:
+        vals = []
+        for x in op.flat:
+            if isinstance(x, _Ref):
+                vals.append(env.get(x.var_id))
+            else:
+                vals.append(_aval_of(x) if _aval_of(x) is not None else x)
+        ins = vals[:op.n_args]
+        try:
+            kw = jtu.tree_unflatten(op.kw_tree, vals[op.n_args:])
+        except Exception:
+            kw = {}
+        if not isinstance(kw, dict):
+            kw = {}
+        out_avals = [v.aval for v in op.out_vars]
+        rule = FLOP_RULES.get(op.name)
+        if rule is not None:
+            fl = float(rule(ins, kw, out_avals))
+        else:
+            ops_scale = [_numel(a) for a in out_avals]
+            ops_scale += [_numel(v) for v in ins if _is_shaped(v)]
+            fl = float(max(ops_scale or [0]))
+        per_op.append(fl)
+        for oid, oaval in zip(op.out_ids, out_avals):
+            env[oid] = oaval
+    return {"per_op": per_op, "total": float(sum(per_op))}
 
 
 # ---------------------------------------------------------------------------

@@ -107,9 +107,9 @@ def test_huge_vocab_sharded_embedding_mesh8():
         emb = jnp.take(tbl, jnp.where(valid, local, 0), axis=0)
         return lax.psum(jnp.where(valid[:, None], emb, 0.0), "tp")
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(mesh_mod.shard_map(
         spmd, mesh=mesh, in_specs=(P("tp", None), P()),
-        out_specs=P(), check_vma=False))(table, ids)
+        out_specs=P()))(table, ids)
     want = host[np.asarray(ids)]
     np.testing.assert_allclose(np.asarray(out), want, atol=1e-6)
     mesh_mod.init_mesh({"dp": 8})

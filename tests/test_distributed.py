@@ -45,7 +45,7 @@ def test_collectives_inside_shard_map(mesh8):
         mx = collective.all_reduce(xl * 1.0, op=collective.ReduceOp.MAX)
         return s, mx
 
-    s, mx = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+    s, mx = mesh_mod.shard_map(body, mesh=mesh8, in_specs=P("dp"),
                           out_specs=P("dp"))(x)
     np.testing.assert_allclose(np.asarray(s), np.full(8, 28.0))
     np.testing.assert_allclose(np.asarray(mx), np.full(8, 7.0))
@@ -60,7 +60,7 @@ def test_reduce_scatter_and_alltoall(mesh8):
         a2a = collective._alltoall_raw(xl[0], axis="dp")
         return rs[None], a2a[None]
 
-    rs, a2a = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+    rs, a2a = mesh_mod.shard_map(body, mesh=mesh8, in_specs=P("dp"),
                             out_specs=P("dp"))(x)
     # reduce_scatter of rows 0..7: rank r gets sum over ranks of element r
     np.testing.assert_allclose(np.asarray(rs).reshape(-1),
@@ -79,7 +79,7 @@ def test_broadcast_and_ppermute(mesh8):
                                                    for i in range(8)))
         return b, ring
 
-    b, ring = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+    b, ring = mesh_mod.shard_map(body, mesh=mesh8, in_specs=P("dp"),
                             out_specs=P("dp"))(x)
     np.testing.assert_allclose(np.asarray(b), np.full(8, 3.0))
     np.testing.assert_allclose(np.asarray(ring), np.roll(np.arange(8.0), 1))
@@ -154,8 +154,7 @@ def test_tensor_parallel_linears():
         out = row(h._value if hasattr(h, "_value") else h)
         return out._value if hasattr(out, "_value") else out
 
-    out = jax.shard_map(spmd, mesh=mesh, in_specs=P(), out_specs=P(),
-                        check_vma=False)(jnp.asarray(x))
+    out = mesh_mod.shard_map(spmd, mesh=mesh, in_specs=P(), out_specs=P())(jnp.asarray(x))
     assert np.asarray(out).shape == (4, 8)
     mesh_mod.init_mesh({"dp": 8})
 
@@ -178,7 +177,7 @@ def test_pipeline_matches_sequential():
         mask = (lax.axis_index("pp") == 7).astype(outs.dtype)
         return lax.psum(outs * mask, "pp")
 
-    outs = jax.shard_map(run, mesh=mesh,
+    outs = mesh_mod.shard_map(run, mesh=mesh,
                          in_specs=(P("pp"), P()), out_specs=P())(
         jnp.asarray(ws), xm)
     # sequential reference
@@ -218,7 +217,7 @@ def test_pipeline_loss_and_grads_match():
         return pipeline_loss(stage, mb_loss, xm_l, ym_l, axis="pp")
 
     def outer(ws_full):
-        return jax.shard_map(spmd_loss, mesh=mesh,
+        return mesh_mod.shard_map(spmd_loss, mesh=mesh,
                              in_specs=(P("pp"), P(), P()),
                              out_specs=P())(ws_full, xm, ym).mean()
 
@@ -264,8 +263,8 @@ def test_moe_expert_parallel():
         out = moe(paddle.Tensor(xv, _internal=True))
         return out._value
 
-    out = jax.shard_map(spmd, mesh=mesh, in_specs=(specs, P()),
-                        out_specs=P(), check_vma=False)(globals_,
+    out = mesh_mod.shard_map(spmd, mesh=mesh, in_specs=(specs, P()),
+                        out_specs=P())(globals_,
                                                         jnp.asarray(x))
     assert np.asarray(out).shape == (2, 4, 8)
     assert np.isfinite(np.asarray(out)).all()
@@ -368,7 +367,7 @@ def test_pipeline_1f1b_schedule_matches_gpipe():
                                  schedule=schedule)
 
         def outer(ws_full):
-            return jax.shard_map(spmd_loss, mesh=mesh,
+            return mesh_mod.shard_map(spmd_loss, mesh=mesh,
                                  in_specs=(P("pp"), P(), P()),
                                  out_specs=P())(ws_full, xm, ym).mean()
 
@@ -421,7 +420,7 @@ def test_pipeline_interleaved_matches_sequential_and_grads():
                              schedule="interleaved")
 
     def outer(wr_full):
-        return jax.shard_map(spmd_loss, mesh=mesh,
+        return mesh_mod.shard_map(spmd_loss, mesh=mesh,
                              in_specs=(P("pp"), P(), P()),
                              out_specs=P())(wr_full, xm, ym).mean()
 

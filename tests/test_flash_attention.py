@@ -210,11 +210,10 @@ def test_ring_attention_flash_path_matches():
         ref = _ref(q, k, v, causal=causal)
         paddle.set_flags({"FLAGS_pallas_interpret": True})
         try:
-            out = jax.shard_map(
+            out = mesh_mod.shard_map(
                 lambda ql, kl, vl: _ring_attention_raw(
                     ql, kl, vl, "sp", causal, None),
-                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                check_vma=False)(q, k, v)
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(q, k, v)
         finally:
             paddle.set_flags({"FLAGS_pallas_interpret": False})
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),

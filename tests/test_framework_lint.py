@@ -171,3 +171,56 @@ def test_perf_floor_null_metric_detected():
     assert len(problems) == 1
     assert "scan-fused dispatch reduction" in problems[0]
     assert "non-numeric" in problems[0]
+
+
+def test_pp_schedule_report_registered_and_green():
+    """ISSUE 11 satellite: the pipeline-schedule report was the only
+    pipeline tool outside the lint net — its self_check now pins the
+    report's mesh/microbatch constants against pipeline.py's schedule
+    accounting and the stage-cut planner's objective knobs."""
+    import pp_schedule_report
+    assert "pp_schedule_report" in framework_lint.TOOL_CROSS_CHECKS
+    assert pp_schedule_report.self_check() == []
+
+
+def test_spmd_plan_pipeline_json_schema(capsys):
+    """The `spmd_plan --pipeline --json` schema is CI surface: key
+    drift here breaks tier-1, same pin as the Megatron rediscovery."""
+    import spmd_plan
+    assert spmd_plan.main(["--pipeline", "--json", "--tp", "1"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True
+    assert set(payload) >= {
+        "axis", "bubble", "cuts", "diagnostics", "evaluations",
+        "expert", "frontier_bytes_per_tick", "hand", "inner", "mesh",
+        "num_micro", "num_stages", "num_virtual", "objective", "ok",
+        "schedule", "stages", "wire"}
+    assert payload["axis"] == "pp"
+    assert payload["num_stages"] == 4
+    assert payload["schedule"] == "1f1b"
+    assert len(payload["stages"]) == 4
+    for stage in payload["stages"]:
+        assert set(stage) == {"stage", "op_range", "flops", "hbm_peak",
+                              "param_bytes", "diagnostics"}
+        assert stage["diagnostics"] == 0
+    assert set(payload["wire"]) == {"kind", "axis", "count",
+                                    "bytes_per_tick", "total_bytes"}
+    assert payload["wire"]["kind"] == "ppermute"
+    assert payload["hand"]["objective"] >= payload["objective"]
+    # a second run serializes identically (stability contract)
+    assert spmd_plan.main(["--pipeline", "--json", "--tp", "1"]) == 0
+    assert json.loads(capsys.readouterr().out) == payload
+
+
+def test_spmd_plan_pipeline_ep_prices_all_to_all(capsys):
+    """An ep-mesh MoE plan must place experts and price the all-to-all
+    dispatch/combine wire in the report (golden acceptance)."""
+    import spmd_plan
+    assert spmd_plan.main(["--pipeline", "--json", "--tp", "1",
+                           "--pp", "2", "--ep", "2"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True
+    assert payload["expert"]["axis"] == "ep"
+    assert payload["expert"]["all_to_all_count"] > 0
+    assert payload["expert"]["all_to_all_bytes"] > 0
+    assert any("w_up" in t for t in payload["expert"]["rules"])

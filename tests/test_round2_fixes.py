@@ -28,7 +28,7 @@ def test_allreduce_prod_with_zeros(mesh8):
         return collective._allreduce_raw(xl, axis="dp",
                                          op=collective.ReduceOp.PROD)
 
-    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+    out = mesh_mod.shard_map(body, mesh=mesh8, in_specs=P("dp"),
                         out_specs=P("dp"))(x)
     expect = np.prod(np.asarray(x))
     np.testing.assert_allclose(np.asarray(out), np.full(8, expect))
@@ -42,7 +42,7 @@ def test_allreduce_prod_negative(mesh8):
         return collective._allreduce_raw(xl, axis="dp",
                                          op=collective.ReduceOp.PROD)
 
-    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+    out = mesh_mod.shard_map(body, mesh=mesh8, in_specs=P("dp"),
                         out_specs=P("dp"))(x)
     np.testing.assert_allclose(np.asarray(out),
                                np.full(8, np.prod(np.asarray(x))))
@@ -56,7 +56,7 @@ def test_reduce_scatter_max(mesh8):
         return collective._reduce_scatter_raw(
             xl[0], axis="dp", op=collective.ReduceOp.MAX)[None]
 
-    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+    out = mesh_mod.shard_map(body, mesh=mesh8, in_specs=P("dp"),
                         out_specs=P("dp"))(x)
     np.testing.assert_allclose(np.asarray(out).reshape(-1),
                                np.asarray(x).max(axis=0))
@@ -69,7 +69,7 @@ def test_broadcast_bool_dtype(mesh8):
     def body(xl):
         return collective._broadcast_raw(xl, axis="dp", src=2)
 
-    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+    out = mesh_mod.shard_map(body, mesh=mesh8, in_specs=P("dp"),
                         out_specs=P("dp"))(x)
     assert np.asarray(out).dtype == np.bool_
     np.testing.assert_array_equal(np.asarray(out), np.full(8, True))
@@ -88,7 +88,7 @@ def test_subgroup_allreduce(mesh8):
             xl, axis="dp", op=collective.ReduceOp.SUM,
             groups=collective._hashable(g.index_groups()))
 
-    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+    out = mesh_mod.shard_map(body, mesh=mesh8, in_specs=P("dp"),
                         out_specs=P("dp"))(x)
     expect = np.asarray([6.0, 6.0, 6.0, 6.0, 4.0, 5.0, 6.0, 7.0])
     np.testing.assert_allclose(np.asarray(out), expect)
@@ -101,7 +101,7 @@ def test_subgroup_broadcast(mesh8):
         return collective._broadcast_raw(xl, axis="dp", src=1,
                                          members=(1, 5, 6))
 
-    out = jax.shard_map(body, mesh=mesh8, in_specs=P("dp"),
+    out = mesh_mod.shard_map(body, mesh=mesh8, in_specs=P("dp"),
                         out_specs=P("dp"))(x)
     expect = np.asarray([0.0, 1.0, 2.0, 3.0, 4.0, 1.0, 1.0, 7.0])
     np.testing.assert_allclose(np.asarray(out), expect)

@@ -361,7 +361,8 @@ def check_concretization(ops_dir=OPS_DIR):
 # list of violation strings, and `main(argv)` for standalone use.
 TOOL_CROSS_CHECKS = ["spmd_lint", "spmd_plan", "hlo_evidence",
                      "pipeline_lint", "obs_report", "ps_load_test",
-                     "elastic_drill", "serve_load_test"]
+                     "elastic_drill", "serve_load_test",
+                     "pp_schedule_report"]
 
 
 def check_registered_tools():

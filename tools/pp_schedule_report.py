@@ -82,8 +82,9 @@ def build(schedule):
                                  xm_l, ym_l, axis="pp", schedule=schedule)
 
     def outer(a):
-        return jax.shard_map(spmd, mesh=mesh, in_specs=(P("pp"), P(), P()),
-                             out_specs=P())(a, xm, ym).mean()
+        return mesh_mod.shard_map(spmd, mesh=mesh,
+                                  in_specs=(P("pp"), P(), P()),
+                                  out_specs=P())(a, xm, ym).mean()
 
     fn = jax.jit(jax.value_and_grad(outer))
     return fn, arg, ws, x, y
@@ -131,6 +132,73 @@ def stage_program_estimate():
         return est
     finally:
         paddle.disable_static()
+
+
+def self_check():
+    """Violation strings for framework_lint's TOOL_CROSS_CHECKS: pins
+    this report's mesh/microbatch constants against pipeline.py's
+    schedule accounting and the stage-cut planner's objective knobs, so
+    the three can't drift apart silently (this was the only pipeline
+    tool outside the lint net)."""
+    problems = []
+    from paddle_tpu.core.flags import flag
+    from paddle_tpu.distributed.pipeline import (bubble_fraction,
+                                                 schedule_collectives,
+                                                 schedule_ticks)
+
+    # the report's schedule set is exactly what schedule_ticks accounts
+    # (all three rows pin the v=N_VIRTUAL formulae the report prints)
+    for schedule in ("gpipe", "1f1b", "interleaved"):
+        ticks = schedule_ticks(N_MICRO, N_STAGES, schedule, N_VIRTUAL)
+        want = (N_VIRTUAL * N_MICRO + N_STAGES - 1
+                if schedule == "interleaved"
+                else N_VIRTUAL * (N_MICRO + N_STAGES - 1))
+        if ticks != want:
+            problems.append(
+                f"pp_schedule_report: schedule_ticks({schedule}) = "
+                f"{ticks}, report math expects {want} — the report's "
+                "tick column no longer matches pipeline.py")
+        bub = bubble_fraction(N_MICRO, N_STAGES, schedule, N_VIRTUAL)
+        if not (0.0 <= bub < 1.0):
+            problems.append(
+                f"pp_schedule_report: bubble_fraction({schedule}) = "
+                f"{bub} out of [0, 1)")
+    # degenerate shapes must price sanely (the cost model feeds the
+    # planner: a crash here is a crash in plan_pipeline)
+    if bubble_fraction(N_MICRO, 1) != 0.0:
+        problems.append("pp_schedule_report: single-stage bubble != 0")
+    if schedule_collectives(N_MICRO, 1, 1024)["total_bytes"] != 0:
+        problems.append(
+            "pp_schedule_report: single-stage pipeline prices nonzero "
+            "ppermute wire")
+    if schedule_ticks(2, N_STAGES) != 2 + N_STAGES - 1:
+        problems.append(
+            "pp_schedule_report: num_micro < num_stages must still "
+            "price M+n-1 ticks")
+    # the planner's pp objective knobs this report's numbers anchor
+    for name, want in (("FLAGS_spmd_plan_pp_micro", 8),
+                       ("FLAGS_spmd_plan_pp_beam", 8),
+                       ("FLAGS_spmd_plan_pp_flops_weight", 1.0),
+                       ("FLAGS_spmd_plan_pp_wire_weight", 1.0),
+                       ("FLAGS_spmd_plan_pp_hbm_weight", 1.0),
+                       ("FLAGS_spmd_plan_pp_bubble_weight", 1.0)):
+        try:
+            got = flag(name)
+        except Exception as e:  # noqa: BLE001
+            problems.append(
+                f"pp_schedule_report: planner knob {name} missing ({e})")
+            continue
+        if got != want:
+            problems.append(
+                f"pp_schedule_report: planner knob {name} default "
+                f"changed to {got!r} (docs/spmd_planner.md flag table "
+                f"says {want!r}) — update the doc and this pin together")
+    if N_MICRO % N_STAGES != 0:
+        problems.append(
+            "pp_schedule_report: N_MICRO must stay divisible by "
+            "N_STAGES (the interleaved schedule's injection-group "
+            "contract)")
+    return problems
 
 
 def main():

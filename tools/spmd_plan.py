@@ -70,6 +70,92 @@ def _metrics(report):
             "diagnostics": len(report.diagnostics)}
 
 
+def build_moe_program(layers=4, hidden=64, experts=4, d_hidden=None,
+                      batch=4, seq=16, name="spmd_plan_moe"):
+    """A dense+MoE stack (the expert-parallel workload): `layers` blocks
+    of Linear -> tanh -> MoELayer. Returns (program, names) with
+    dotted display names for the rule templates."""
+    import paddle_tpu as paddle
+    from paddle_tpu import ops, static
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.moe import MoELayer
+
+    was_static = static.in_static_mode()
+    paddle.enable_static()
+    try:
+        main = static.Program(name)
+        names = {}
+        with static.program_guard(main):
+            x = static.data("x", [batch, seq, hidden], "float32")
+            h = x
+            for i in range(layers):
+                lin = nn.Linear(hidden, hidden)
+                moe = MoELayer(hidden, d_hidden or 2 * hidden, experts,
+                               axis="ep")
+                h = ops.tanh(lin(h))
+                h = moe(h)
+                for suffix, p in (("fc.weight", lin.weight),
+                                  ("fc.bias", lin.bias),
+                                  ("moe.gate.weight", moe.gate.weight),
+                                  ("moe.w_up", moe.w_up),
+                                  ("moe.b_up", moe.b_up),
+                                  ("moe.w_down", moe.w_down),
+                                  ("moe.b_down", moe.b_down)):
+                    names[p.scope_name] = f"blocks.{i}.{suffix}"
+        main._jit_fetch_vars = [h]
+        return main, names
+    finally:
+        if not was_static:
+            paddle.disable_static()
+
+
+def build_pipeline_plan(pp=4, dp=1, tp=1, ep=1, micro=8, virtual=1,
+                        layers=None, hidden=64, heads=2, vocab=1024,
+                        batch=8, seq=16, experts=4):
+    """Plan a pipeline partition of the golden workload: the GPT
+    program (spmd_lint's) for dense meshes, the MoE stack when an `ep`
+    axis is requested. Returns the PipelinePlan (its `.inner` carries
+    the non-pp SPMD plan, expert placement included)."""
+    from paddle_tpu.static import spmd_planner
+    from spmd_lint import build_gpt_program
+
+    mesh = {}
+    if pp > 1:
+        mesh["pp"] = pp
+    if dp > 1:
+        mesh["dp"] = dp
+    if tp > 1:
+        mesh["tp"] = tp
+    if ep > 1:
+        mesh["ep"] = ep
+    if ep > 1:
+        program, names = build_moe_program(
+            layers=layers or 4, hidden=hidden, experts=experts,
+            batch=batch, seq=seq)
+        return spmd_planner.plan_pipeline(
+            program, mesh, num_micro=micro, num_virtual=virtual,
+            names=names)
+    program, net, _logits = build_gpt_program(
+        layers=layers or 4, hidden=hidden, heads=heads, vocab=vocab,
+        batch=batch, seq=seq, name="spmd_plan_pp_gpt")
+    return spmd_planner.plan_pipeline(
+        program, mesh, num_micro=micro, num_virtual=virtual, layer=net)
+
+
+def pipeline_json(plan) -> dict:
+    """Stable JSON for CI: the stage table + wire/bubble/objective and
+    the acceptance verdict — zero diagnostics AND the planner's cut
+    matches-or-beats the hand (equal-segments) cut on the weighted
+    objective."""
+    out = plan.to_json()
+    hand_obj = plan.hand.get("objective")
+    out["ok"] = bool(
+        not plan.diagnostics
+        and all(s.diagnostics == 0 for s in plan.stages)
+        and (hand_obj is None or plan.objective <= hand_obj + 1e-9))
+    return out
+
+
 def plan_json(plan, preset, replicated) -> dict:
     """Stable JSON for CI: the plan's rule list + the three-way cost
     table + the acceptance verdict."""
@@ -147,6 +233,24 @@ def self_check():
         problems.append(
             "spmd_plan dp x tp config: input_ids not sharded on dp "
             f"(got {ids_spec})")
+    # the pipeline golden: {pp: 4} on the GPT workload must produce a
+    # clean 4-stage partition that matches-or-beats the hand
+    # (equal-segments) cut on the weighted objective
+    try:
+        pplan = build_pipeline_plan(pp=4)
+    except Exception as e:  # noqa: BLE001
+        return problems + [f"spmd_plan --pipeline self-check crashed: "
+                           f"{e!r}"]
+    payload = pipeline_json(pplan)
+    if not payload["ok"]:
+        problems.append(
+            "spmd_plan pipeline golden {pp:4}: plan not ok — "
+            f"diagnostics {pplan.diagnostics}, objective "
+            f"{pplan.objective} vs hand {pplan.hand.get('objective')}")
+    if len(pplan.stages) != 4:
+        problems.append(
+            f"spmd_plan pipeline golden {{pp:4}}: {len(pplan.stages)} "
+            "stages planned, expected 4")
     return problems
 
 
@@ -158,7 +262,9 @@ def main(argv=None):
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--sp", type=int, default=1)
-    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--layers", type=int, default=None,
+                    help="transformer layers (default: 2, or 4 in "
+                         "--pipeline mode)")
     ap.add_argument("--hidden", type=int, default=64)
     ap.add_argument("--heads", type=int, default=2)
     ap.add_argument("--vocab", type=int, default=1024)
@@ -174,10 +280,40 @@ def main(argv=None):
                     help="offer ZeRO-style dim-0 dp sharding candidates")
     ap.add_argument("--json", action="store_true",
                     help="stable JSON on stdout (CI consumption)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="plan pipeline stage cuts (and MoE expert "
+                         "placement with --ep) instead of a single-SPMD "
+                         "layout; --pp sets the stage count")
+    ap.add_argument("--pp", type=int, default=4,
+                    help="pipeline stages (--pipeline mode)")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel degree: >1 plans the MoE "
+                         "workload with experts sharded over 'ep'")
+    ap.add_argument("--micro", type=int, default=8,
+                    help="microbatches priced per step")
+    ap.add_argument("--virtual", type=int, default=1,
+                    help="virtual chunks per rank (interleaved 1F1B)")
     args = ap.parse_args(argv)
 
+    if args.pipeline:
+        plan = build_pipeline_plan(
+            pp=args.pp, dp=args.dp, tp=args.tp, ep=args.ep,
+            micro=args.micro, virtual=args.virtual, layers=args.layers,
+            hidden=args.hidden, heads=args.heads, vocab=args.vocab,
+            batch=max(args.batch, args.micro), seq=args.seq)
+        payload = pipeline_json(plan)
+        if args.json:
+            print(json.dumps(payload, sort_keys=True, indent=1))
+        else:
+            print(plan.stage_table())
+            print(f"search: {plan.evaluations} stage evaluations, "
+                  f"{plan.inner.evaluations if plan.inner else 0} "
+                  "layout evaluations")
+        return 0 if payload["ok"] else 1
+
     plan, preset, replicated, _prog, _net, _logits = build_plan(
-        tp=args.tp, dp=args.dp, sp=args.sp, layers=args.layers,
+        tp=args.tp, dp=args.dp, sp=args.sp,
+        layers=2 if args.layers is None else args.layers,
         hidden=args.hidden, heads=args.heads, vocab=args.vocab,
         batch=args.batch, seq=args.seq, beam=args.beam,
         coll_weight=args.coll_weight, hbm_weight=args.hbm_weight,
