@@ -303,6 +303,36 @@ define_flag("PADDLE_PS_FAILOVER_BACKOFF_S", 0.25,
             "base pause between client failover re-routes (grows "
             "linearly up to 4x)")
 
+# --- sharded embedding engine (distributed/ps/{client,heter,embedding}.py) --
+define_flag("PADDLE_PS_FANOUT_THREADS", 4,
+            "per-shard fan-out concurrency of batched sparse lookups: a "
+            "pull whose (deduped) ids span several shard primaries issues "
+            "one RPC per shard from a pool of this many threads, so the "
+            "batch costs max(shard latency), not the sum. 1 restores the "
+            "serial per-shard loop (bitwise-identical results either way "
+            "— shard slices are disjoint)")
+define_flag("PADDLE_PS_PREFETCH_DEPTH", 2,
+            "embedding-prefetch window depth (distributed/ps/embedding."
+            "EmbeddingPrefetcher riding static/pipeline_runner."
+            "InflightDriver): how many batches of sparse pulls may be in "
+            "flight ahead of the training step. Results stay BITWISE "
+            "equal to synchronous pulls: ids pushed after a batch's "
+            "prefetch snapshot are re-pulled at materialization "
+            "(conflict fix-up), so overlap never trades determinism")
+define_flag("PADDLE_PS_HETER_CACHE_ROWS", 65536,
+            "hot-id LRU bound on the HeterPS device-resident embedding "
+            "cache (distributed/ps/heter.HeterPSCache): rows past the "
+            "bound evict oldest-first into the host-RAM tier (see "
+            "PADDLE_PS_HETER_HOST_ROWS), bumping ps.heter.evictions — "
+            "device HBM holds the hot working set, not the vocab")
+define_flag("PADDLE_PS_HETER_HOST_ROWS", 262144,
+            "host-RAM second tier of the HeterPS cache: rows evicted "
+            "from the device LRU park here (HeterPS lineage — tables "
+            "larger than device memory tier through host DRAM before "
+            "the PS); a host hit re-promotes without a PS RPC "
+            "(ps.heter.host_hits). 0 disables the tier (evictions go "
+            "straight back to the PS)")
+
 # --- trainer-side fault tolerance (incubate/checkpoint.py,
 # --- distributed/elastic.py Supervisor, distributed/launch.py --elastic) --
 define_flag("PADDLE_CKPT_VERIFY", True,

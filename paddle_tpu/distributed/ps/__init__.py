@@ -22,6 +22,7 @@ from __future__ import annotations
 import numpy as np
 
 from .client import Communicator, PSClient
+from .embedding import EmbeddingPrefetcher
 from .heter import DeviceHashTable, HeterPSCache
 from .replica import ReplicaManager
 from .rpc import AuthError, ConnectRefused, DeadlineExceeded, FrameError
@@ -33,6 +34,7 @@ from .table import (BarrierTable, DenseTable, GeoSparseTable, SparseTable,
 __all__ = ["PSServer", "PSClient", "Communicator", "DenseTable",
            "SparseTable", "GeoSparseTable", "BarrierTable", "make_table",
            "SparseEmbedding", "DeviceHashTable", "HeterPSCache",
+           "EmbeddingPrefetcher",
            "DeadlineExceeded", "FrameError", "AuthError", "ConnectRefused",
            "ShardMap", "ShardMapStale", "ReplicaManager"]
 

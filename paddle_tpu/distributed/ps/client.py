@@ -29,6 +29,8 @@ import queue
 import threading
 import time
 import uuid
+import weakref
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -81,6 +83,11 @@ class PSClient:
         self._seq = 0
         self._seq_lock = threading.Lock()
         self._map_lock = threading.Lock()
+        # shard-map change listeners (HeterPSCache invalidation rides
+        # these) + the lazy per-shard fan-out pool for batched lookups
+        self._listeners: list = []
+        self._fanout_pool: ThreadPoolExecutor | None = None
+        self._fanout_lock = threading.Lock()
         if shard_map is not None:
             self._map = shard_map if isinstance(shard_map, ShardMap) \
                 else ShardMap.from_dict(shard_map)
@@ -109,7 +116,36 @@ class PSClient:
         if new.epoch > 0 or any(new.backups(s)
                                 for s in range(new.n_shards)):
             self._enable_fail_fast()
+        # a membership change invalidates every derived caching layer:
+        # listeners fire OUTSIDE the map lock (an invalidation may pull)
+        for ref in list(self._listeners):
+            fn = ref()
+            if fn is None:
+                try:       # owner died: the weak registration self-prunes
+                    self._listeners.remove(ref)
+                except ValueError:
+                    pass
+                continue
+            try:
+                fn(new)
+            except Exception:  # noqa: BLE001 — listeners must not block
+                pass           # adoption (routing correctness comes first)
         return True
+
+    def add_map_listener(self, fn):
+        """Register fn(new_map), called after every shard-map adoption
+        (stale redirect, failover refresh, epoch gossip). The sharded
+        caching tier registers its invalidation here so a stale cached
+        row can never survive a membership change. Bound methods are
+        held WEAKLY — a discarded cache unregisters itself instead of
+        being pinned (and fired) for the client's whole lifetime."""
+        try:
+            ref = weakref.WeakMethod(fn)
+        except TypeError:
+            # plain function/lambda: no owner to outlive, pin it
+            ref = (lambda f=fn: f)
+        self._listeners.append(ref)
+        return fn
 
     def _enable_fail_fast(self):
         # with backups in the map a refused dial means "fail over NOW",
@@ -225,11 +261,19 @@ class PSClient:
                 last = e
                 self._drop_conn(ep)
                 advanced = self.refresh_shard_map()
-                if not advanced and not self._map.backups(shard):
+                # a parallel fan-out sibling (or a stale-map redirect on
+                # another thread) may have adopted the post-promotion map
+                # already: refresh reports no advance, but the shard no
+                # longer routes HERE — that is a re-route, not a dead end
+                moved = self._map.primary(shard) != ep
+                if not advanced and not moved \
+                        and not self._map.backups(shard):
                     # nowhere to fail over to (unreplicated map, or the
                     # shard lost its last backup): keep the transport's
                     # original fail-loud contract
                     raise
+                if moved:
+                    continue       # the new primary is live: no pacing
                 if attempt < attempts - 1:
                     # a promotion needs a heartbeat deadline to pass —
                     # linear backoff paces the re-route loop across it
@@ -261,45 +305,126 @@ class PSClient:
                      value=np.asarray(value, np.float32))
 
     # -------------------------------------------------------------- sparse
-    def _shard(self, ids):
-        ids, owner = self._map.shard_of_ids(ids)
-        return ids, owner
+    def _fanout(self, shards, call_one):
+        """Run call_one(shard) for every shard in `shards` — in parallel
+        from the fan-out pool when there is more than one shard (a batch
+        costs max(shard latency), not the sum), serially otherwise or
+        when PADDLE_PS_FANOUT_THREADS is 1. Shard slices are disjoint,
+        so results are bitwise-independent of the execution order.
+
+        READS ONLY. Mutations keep the serial per-shard loop: a primary
+        holds its per-table gate across the synchronous forward to its
+        backups, so one client pushing several shard chains CONCURRENTLY
+        can close a circular wait across the chained cluster (server i
+        holds its gate waiting on server i+1, whose handler waits on the
+        gate... all the way around). Serial pushes make that cycle
+        impossible by construction — a client never holds two chains."""
+        n_threads = int(_flag("PADDLE_PS_FANOUT_THREADS"))
+        if len(shards) <= 1 or n_threads <= 1:
+            for s in shards:
+                call_one(int(s))
+            return
+        with self._fanout_lock:
+            if self._fanout_pool is None:
+                self._fanout_pool = ThreadPoolExecutor(
+                    max_workers=n_threads,
+                    thread_name_prefix="ps-client-fanout")
+            pool = self._fanout_pool
+        futures = [pool.submit(call_one, int(s)) for s in shards]
+        err = None
+        for f in futures:
+            try:
+                f.result()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err = err or e
+        if err is not None:
+            raise err
 
     def pull_sparse(self, table, ids):
         """Gather rows for (possibly duplicated) ids; returns
-        [len(ids), dim] in input order. Reads always hit the primary."""
-        ids, owner = self._shard(ids)
-        out = None
-        for s in np.unique(owner):
-            mask = owner == s
-            rows = self._routed(int(s), "pull_sparse", table=table,
-                                ids=ids[mask])
-            if out is None:
-                out = np.empty((len(ids), rows.shape[1]), np.float32)
-            out[mask] = rows
-        if out is None:
-            raise ValueError("pull_sparse with zero ids")
-        return out
+        [len(ids), dim] in input order. Reads always hit the primary.
+
+        The batch is deduped BEFORE the wire (`SparseTable._ensure`'s
+        order-preserving dedupe generalized to the cross-shard
+        scatter/gather): a batch like [5, 9, 5] costs one row per shard
+        regardless of routing, and the per-shard slices fan out in
+        parallel (PADDLE_PS_FANOUT_THREADS). The inverse mapping gathers
+        unique rows back to input positions, so the caller sees exactly
+        the legacy per-position contract."""
+        ids_in = np.asarray(ids, np.int64).reshape(-1)
+        if ids_in.size == 0:
+            # empty batch: route like a dense table (any shard can
+            # answer) so the caller still gets a [0, dim]-shaped block
+            shard = self._map.shard_of_name(table)
+            return np.asarray(self._routed(shard, "pull_sparse",
+                                           table=table, ids=ids_in),
+                              np.float32)
+        uniq, inv = np.unique(ids_in, return_inverse=True)
+        _monitor.stat_add("ps.client.pull_ids", int(ids_in.size))
+        _monitor.stat_add("ps.client.pull_unique_rows", int(uniq.size))
+        uniq, owner = self._map.shard_of_ids(uniq)
+        shards = np.unique(owner)
+        per_shard: dict[int, np.ndarray] = {}
+
+        def pull_one(s):
+            rows = np.asarray(self._routed(int(s), "pull_sparse",
+                                           table=table,
+                                           ids=uniq[owner == s]),
+                              np.float32)
+            _monitor.stat_add("ps.client.pull_rpcs")
+            per_shard[s] = rows     # disjoint keys: no cross-thread race
+
+        self._fanout(shards, pull_one)
+        dim = next(iter(per_shard.values())).shape[1]
+        out = np.empty((len(uniq), dim), np.float32)
+        for s, rows in per_shard.items():
+            out[owner == s] = rows
+        return out[inv]
 
     def push_sparse_grad(self, table, ids, grads, request_key=None):
-        ids, owner = self._shard(ids)
-        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        """Duplicate ids are MERGED client-side before the wire
+        (reference MergeAdd over SelectedRows), bitwise-identical to the
+        server-side merge it used to ride: np.unique yields the same
+        sorted unique set and np.add.at accumulates rows in the same
+        input order either side of the wire."""
+        ids, owner, merged = self._merged(ids, grads)
+        if ids is None:
+            return
+
         for s in np.unique(owner):
             mask = owner == s
             key = self._rkey(request_key, "psg", table)
             self._routed(int(s), "push_sparse_grad", _mutating=True,
                          _key=None if key is None else key + (int(s),),
-                         table=table, ids=ids[mask], grads=grads[mask])
+                         table=table, ids=ids[mask], grads=merged[mask])
 
     def push_sparse_delta(self, table, ids, deltas, request_key=None):
-        ids, owner = self._shard(ids)
-        deltas = np.asarray(deltas, np.float32).reshape(len(ids), -1)
+        ids, owner, merged = self._merged(ids, deltas)
+        if ids is None:
+            return
+
         for s in np.unique(owner):
             mask = owner == s
             key = self._rkey(request_key, "psd", table)
             self._routed(int(s), "push_sparse_delta", _mutating=True,
                          _key=None if key is None else key + (int(s),),
-                         table=table, ids=ids[mask], deltas=deltas[mask])
+                         table=table, ids=ids[mask], deltas=merged[mask])
+
+    def _merged(self, ids, grads):
+        """(unique ids, owner shards, merged grads) for a sparse push —
+        (None, None, None) for an empty batch (nothing to send)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if ids.size == 0:
+            return None, None, None
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        uniq, inv = np.unique(ids, return_inverse=True)
+        if len(uniq) != len(ids):
+            merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
+            np.add.at(merged, inv, grads)
+        else:
+            merged = grads[np.argsort(ids, kind="stable")]
+        uniq, owner = self._map.shard_of_ids(uniq)
+        return uniq, owner, merged
 
     # --------------------------------------------------------------- misc
     def barrier(self, table, trainer_id, timeout=120.0):
@@ -361,6 +486,11 @@ class PSClient:
                 pass
 
     def close(self):
+        self._listeners.clear()
+        with self._fanout_lock:
+            pool, self._fanout_pool = self._fanout_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
         with self._conns_lock:
             conns = list(self._conns.values())
             self._conns.clear()
@@ -523,11 +653,9 @@ class Communicator:
             ids = np.concatenate([p[0] for p in parts])
             grads = np.concatenate(
                 [p[1].reshape(len(p[0]), -1) for p in parts])
-            # merge duplicates before the wire (reference MergeAdd)
-            uniq, inv = np.unique(ids, return_inverse=True)
-            merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
-            np.add.at(merged, inv, grads)
-            self._client.push_sparse_grad(table, uniq, merged, **kw)
+            # duplicate merging (reference MergeAdd) happens ONCE, in
+            # PSClient._merged, before the wire — not re-implemented here
+            self._client.push_sparse_grad(table, ids, grads, **kw)
         for table, grad in dense.items():
             self._client.push_dense_grad(table, grad, **kw)
 

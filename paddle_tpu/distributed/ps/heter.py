@@ -1,4 +1,4 @@
-"""HeterPS — accelerator-resident embedding cache over the host PS.
+"""HeterPS — tiered accelerator-resident embedding cache over the host PS.
 
 Reference tier: framework/fleet/heter_ps/hashtable.h + heter_comm.h (a
 GPU-resident concurrent hashtable caching hot embedding rows, backed by
@@ -9,17 +9,42 @@ the training step, and INSERT as a lax.fori_loop of dynamic updates (runs
 once per batch on the miss set, off the hot path). No device hashtable
 kernels to hand-write — XLA lowers both to gathers/scatters.
 
+The cache is TIERED (HeterPS lineage — tables larger than device memory):
+
+  device tier   hot-id LRU, bounded by PADDLE_PS_HETER_CACHE_ROWS; rows
+                past the bound evict oldest-first (`ps.heter.evictions`)
+  host tier     evicted rows park in host RAM, bounded by
+                PADDLE_PS_HETER_HOST_ROWS; a host hit re-promotes to the
+                device tier without a PS round trip (`ps.heter.host_hits`)
+  PS tier       authoritative sharded storage; misses in both tiers pull
+                through the client's batched deduped cross-shard fan-out
+
 Semantics: read-through cache with push-through writes —
-  rows = cache.pull(ids)        # device hits + host PS misses
+  rows = cache.pull(ids)        # device hits + host hits + PS misses
   ...                           # grads computed on device
   cache.push_grad(ids, grads)   # goes to the PS (server accessor owns
                                 # the update rule), cached copies refresh
 so the server stays authoritative (same division of labor as the
 reference: hashtable.h caches, the DownpourPsClient owns optimizer state).
+
+Coherence across MEMBERSHIP CHANGES: the cache registers a shard-map
+listener on its PSClient (`add_map_listener`), so every adoption of a
+newer map — stale-epoch redirect, failover promotion, eviction gossip —
+invalidates BOTH tiers (`ps.heter.invalidations`): a row cached before a
+promotion can never be served after it. A pull that was already in
+flight when the epoch moved re-checks the epoch before populating the
+tiers and skips the insert, closing the race where pre-change rows
+sneak into a post-change cache.
 """
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
+
 import numpy as np
+
+from ...core import monitor as _monitor
+from ...core.flags import flag as _flag
 
 __all__ = ["DeviceHashTable", "HeterPSCache"]
 
@@ -36,7 +61,10 @@ def _mix(h):
 
 class DeviceHashTable:
     """Fixed-capacity open-addressing (linear probe) id -> row table as a
-    functional pytree of device arrays."""
+    functional pytree of device arrays. Supports vectorized remove() so
+    an LRU layer above can evict; lookups scan the FULL probe window
+    (no early stop at an empty slot), which is what makes removal safe
+    under linear probing without tombstones."""
 
     def __init__(self, capacity, dim, max_probes=16, dtype="float32"):
         import jax.numpy as jnp
@@ -70,39 +98,75 @@ class DeviceHashTable:
         rows = self.values[sel] * found[:, None].astype(self.values.dtype)
         return rows, found
 
-    def insert(self, ids, rows):
+    def insert(self, ids, rows, best_effort=False):
         """Functional batch insert (linear probing; existing keys are
-        overwritten). Raises if the probe window is exhausted — size the
-        capacity >= ~2x the working set."""
+        overwritten). A row whose probe window is exhausted either
+        raises (default — size the capacity >= ~2x the working set) or,
+        with ``best_effort=True``, is skipped: the caller gets the
+        per-row placed mask back and decides where unplaced rows live
+        (the tiered cache demotes them to host RAM — a CACHE must never
+        hard-fail because 16 consecutive slots happened to cluster)."""
         import jax
         import jax.numpy as jnp
         ids = jnp.asarray(ids, jnp.int64).reshape(-1)
         rows = jnp.asarray(rows, self.values.dtype).reshape(
             ids.shape[0], self.dim)
         slots = self._slots(ids)
+        placed0 = jnp.zeros((ids.shape[0],), bool)
 
         def body(i, carry):
-            keys, values, ok = carry
+            keys, values, placed_vec = carry
             cand = slots[i]
             kcand = keys[cand]
-            usable = (kcand == _EMPTY) | (kcand == ids[i])
-            j = jnp.argmax(usable)
+            match = kcand == ids[i]
+            usable = (kcand == _EMPTY) | match
+            # prefer the MATCHING slot over an earlier empty one: after a
+            # remove() opened a hole in this id's probe chain, landing in
+            # the hole would leave a stale duplicate further down the
+            # window that could resurface after the fresh copy is evicted
+            j = jnp.where(match.any(), jnp.argmax(match), jnp.argmax(usable))
             slot = cand[j]
             placed = usable.any()
             keys = keys.at[slot].set(jnp.where(placed, ids[i], keys[slot]))
             values = values.at[slot].set(
                 jnp.where(placed, rows[i], values[slot]))
-            return keys, values, ok & placed
+            return keys, values, placed_vec.at[i].set(placed)
 
-        keys, values, ok = jax.lax.fori_loop(
-            0, ids.shape[0], body,
-            (self.keys, self.values, jnp.asarray(True)))
-        if not bool(ok):
+        keys, values, placed_vec = jax.lax.fori_loop(
+            0, ids.shape[0], body, (self.keys, self.values, placed0))
+        placed_np = np.asarray(placed_vec)
+        if not best_effort and not placed_np.all():
             raise RuntimeError(
                 f"DeviceHashTable over capacity ({self.capacity} slots, "
                 f"{self.max_probes} probes) — grow it or evict")
         self.keys, self.values = keys, values
         self._count = int(np.sum(np.asarray(keys) != _EMPTY))
+        return placed_np if best_effort else self
+
+    def remove(self, ids):
+        """Vectorized batch remove: present ids' slots flip back to
+        EMPTY (values left in place — unreachable once the key is gone,
+        because lookup masks by `found`). Absent ids are ignored."""
+        import jax.numpy as jnp
+        ids = jnp.asarray(ids, jnp.int64).reshape(-1)
+        if ids.shape[0] == 0:
+            return self
+        slots = self._slots(ids)
+        hit = self.keys[slots] == ids[:, None]
+        found = np.asarray(hit.any(axis=1))
+        idx = jnp.argmax(hit, axis=1)
+        sel = np.asarray(jnp.take_along_axis(slots, idx[:, None],
+                                             axis=1)[:, 0])
+        # scatter ONLY the found rows' slots: an absent id's bogus slot-0
+        # candidate may alias a present id's slot, and a duplicate-index
+        # scatter writing {EMPTY, old-key} to one slot resolves in
+        # unspecified order — the removed key could resurrect
+        if found.any():
+            self.keys = self.keys.at[jnp.asarray(sel[found])].set(_EMPTY)
+            # incremental count (unique slots: robust to duplicate ids)
+            # instead of re-scanning the whole keys array to host on
+            # every LRU-eviction batch
+            self._count -= len(np.unique(sel[found]))
         return self
 
     def __len__(self):
@@ -110,59 +174,211 @@ class DeviceHashTable:
 
 
 class HeterPSCache:
-    """Read-through device cache over a PSClient sparse table."""
+    """Tiered read-through device cache over a PSClient sparse table.
 
-    def __init__(self, client, table, dim, capacity=1 << 16,
-                 max_probes=16):
+    `capacity` bounds the DEVICE tier's resident rows (None -> the
+    PADDLE_PS_HETER_CACHE_ROWS flag); `host_rows` bounds the host tier
+    (None -> PADDLE_PS_HETER_HOST_ROWS, 0 disables it). All state is
+    serialized under one reentrant lock, so a background prefetch pull
+    and the trainer's push cannot interleave a stale row into a tier.
+    """
+
+    def __init__(self, client, table, dim, capacity=None, max_probes=16,
+                 host_rows=None):
         self.client = client
         self.table = table
-        self.dev = DeviceHashTable(capacity, dim, max_probes)
+        self.dim = int(dim)
+        self._bound = int(_flag("PADDLE_PS_HETER_CACHE_ROWS")
+                          if capacity is None else capacity)
+        self._host_bound = int(_flag("PADDLE_PS_HETER_HOST_ROWS")
+                               if host_rows is None else host_rows)
+        self._max_probes = int(max_probes)
+        # device slots ~2x the row bound: linear probing needs headroom
+        self.dev = DeviceHashTable(max(2 * self._bound, 64), dim,
+                                   max_probes)
+        self._lru: OrderedDict[int, bool] = OrderedDict()   # device ids
+        self._host: OrderedDict[int, np.ndarray] = OrderedDict()
+        self._lock = threading.RLock()
+        self._invalidate_pending = False
+        self._valid_epoch = self._epoch()
         self.hits = 0
         self.misses = 0
+        # membership-change coherence: any shard-map adoption on the
+        # client (promotion, eviction, stale redirect) nukes both tiers
+        if hasattr(client, "add_map_listener"):
+            client.add_map_listener(self._on_map_change)
 
+    # ------------------------------------------------------------- helpers
+    def _epoch(self):
+        m = getattr(self.client, "shard_map", None)
+        return getattr(m, "epoch", 0)
+
+    def _on_map_change(self, _new_map):
+        # DEFERRED, not inline: the adoption may fire on a fan-out
+        # worker that this cache's in-flight pull is itself waiting on —
+        # taking the cache lock here would deadlock. Serving only ever
+        # happens through pull(), and pull() applies the pending
+        # invalidation before reading a single row, so no pre-change hit
+        # can be served after the membership change.
+        self._invalidate_pending = True
+
+    def _revalidate(self):
+        """Caller holds self._lock. Two triggers, one clear: the
+        listener's pending flag, AND a synchronous epoch comparison —
+        the listener fires OUTSIDE the client's map lock, so another
+        thread's adoption can complete (map swapped) a beat before the
+        flag lands; reading the epoch here cannot lag the swap, so an
+        adoption that happened-before this call always invalidates
+        before a single row is read."""
+        e = self._epoch()
+        if self._invalidate_pending or e != self._valid_epoch:
+            self._invalidate_pending = False
+            self._valid_epoch = e
+            self._clear_tiers()
+            _monitor.stat_add("ps.heter.invalidations")
+
+    def __len__(self):
+        with self._lock:
+            return len(self._lru)
+
+    @property
+    def host_len(self):
+        with self._lock:
+            return len(self._host)
+
+    def _host_put(self, i, row):
+        """Caller holds self._lock; bounded host-tier upsert."""
+        if self._host_bound <= 0:
+            return
+        self._host[int(i)] = np.asarray(row, np.float32).copy()
+        self._host.move_to_end(int(i))
+        while len(self._host) > self._host_bound:
+            self._host.popitem(last=False)
+
+    def _insert_device(self, ids, rows):
+        """Caller holds self._lock. Best-effort device insert: rows
+        whose probe window is exhausted demote to the host tier instead
+        of failing the pull (`ps.heter.probe_drops`). Returns the ids
+        that are actually device-resident."""
+        placed = self.dev.insert(ids, rows, best_effort=True)
+        if not placed.all():
+            _monitor.stat_add("ps.heter.probe_drops",
+                              int((~placed).sum()))
+            for k in np.nonzero(~placed)[0]:
+                self._host_put(ids[k], rows[k])
+        return ids[placed]
+
+    def _touch(self, ids):
+        """Mark device-resident ids as most-recently-used and evict past
+        the bound (device -> host tier demotion)."""
+        for i in ids:
+            i = int(i)
+            self._lru[i] = True
+            self._lru.move_to_end(i)
+        n_evict = len(self._lru) - self._bound
+        if n_evict <= 0:
+            return
+        victims = [self._lru.popitem(last=False)[0] for _ in range(n_evict)]
+        varr = np.asarray(victims, np.int64)
+        if self._host_bound > 0:
+            rows, found = self.dev.lookup(varr)
+            rows = np.asarray(rows, np.float32)
+            found = np.asarray(found)
+            for k, i in enumerate(victims):
+                if found[k]:
+                    self._host_put(i, rows[k])
+        self.dev.remove(varr)
+        _monitor.stat_add("ps.heter.evictions", n_evict)
+
+    # ---------------------------------------------------------------- pull
     def pull(self, ids):
         """ids any-shape ints -> rows [n_unique, dim] (device), index
-        mapping like SparseEmbedding.pull. Misses fetch from the host PS
-        and populate the device table."""
+        mapping like SparseEmbedding.pull. Misses fetch host tier first,
+        then the sharded PS (one batched deduped fan-out), and populate
+        the device table."""
         import jax.numpy as jnp
-        from ...core import monitor
         ids_np = np.asarray(ids, np.int64).reshape(-1)
         uniq, inv = np.unique(ids_np, return_inverse=True)
-        rows, found = self.dev.lookup(uniq)
-        found_np = np.asarray(found)
-        miss = uniq[~found_np]
-        self.hits += int(found_np.sum())
-        self.misses += len(miss)
-        # cache efficiency next to the transport's ps.rpc.* flakiness
-        # counters: a miss storm after a PS reconnect shows up here
-        monitor.stat_add("ps.heter.hits", int(found_np.sum()))
-        monitor.stat_add("ps.heter.misses", len(miss))
-        if len(miss):
-            fetched = np.asarray(self.client.pull_sparse(self.table, miss),
-                                 np.float32)
-            self.dev.insert(miss, fetched)
-            rows = jnp.asarray(rows).at[jnp.asarray(~found_np)].set(
-                jnp.asarray(fetched, self.dev.values.dtype))
+        with self._lock:
+            self._revalidate()
+            epoch0 = self._epoch()
+            rows, found = self.dev.lookup(uniq)
+            found_np = np.asarray(found)
+            miss = uniq[~found_np]
+            n_hits = int(found_np.sum())
+            self.hits += n_hits
+            # cache efficiency next to the transport's ps.rpc.* flakiness
+            # counters: a miss storm after a PS reconnect shows up here
+            _monitor.stat_add("ps.heter.hits", n_hits)
+            if len(miss):
+                fetched = np.empty((len(miss), self.dim), np.float32)
+                host_mask = np.zeros(len(miss), bool)
+                for k, i in enumerate(miss):
+                    row = self._host.pop(int(i), None)
+                    if row is not None:
+                        fetched[k] = row
+                        host_mask[k] = True
+                n_host = int(host_mask.sum())
+                n_ps = len(miss) - n_host
+                self.misses += n_ps
+                _monitor.stat_add("ps.heter.host_hits", n_host)
+                _monitor.stat_add("ps.heter.misses", n_ps)
+                if n_ps:
+                    fetched[~host_mask] = np.asarray(
+                        self.client.pull_sparse(self.table,
+                                                miss[~host_mask]),
+                        np.float32)
+                if self._epoch() == epoch0:
+                    resident = self._insert_device(miss, fetched)
+                    self._touch(np.concatenate([uniq[found_np],
+                                                resident]))
+                # else: the shard map moved UNDER this pull (a failover
+                # resolved it) — serve the rows, but don't let a
+                # pre-change fetch populate the post-change cache
+                rows = jnp.asarray(rows).at[jnp.asarray(~found_np)].set(
+                    jnp.asarray(fetched, self.dev.values.dtype))
+            else:
+                self._touch(uniq)
         return rows, inv.reshape(np.shape(ids))
 
+    # ---------------------------------------------------------------- push
     def push_grad(self, ids, grads):
         """Push grads to the PS (authoritative update), then refresh the
         cached copies with the server's post-update rows."""
         ids_np = np.asarray(ids, np.int64).reshape(-1)
-        uniq, inv = np.unique(ids_np, return_inverse=True)
-        g = np.asarray(grads, np.float32).reshape(len(uniq), -1) \
-            if len(ids_np) == len(uniq) else None
-        if g is None:
-            # merge duplicate-id grads before the wire (MergeAdd)
-            flat = np.asarray(grads, np.float32).reshape(len(ids_np), -1)
-            g = np.zeros((len(uniq), flat.shape[1]), np.float32)
-            np.add.at(g, inv, flat)
-        self.client.push_sparse_grad(self.table, uniq, g)
-        fresh = np.asarray(self.client.pull_sparse(self.table, uniq),
-                           np.float32)
-        self.dev.insert(uniq, fresh)
+        if ids_np.size == 0:
+            return              # no-op, same contract as the client layer
+        # duplicate-id merging (MergeAdd) is the CLIENT's job — one
+        # implementation of the bitwise-sensitive merge, not three; the
+        # cache only needs the unique set for its refresh pull and tiers
+        uniq = np.unique(ids_np)
+        with self._lock:
+            self._revalidate()
+            epoch0 = self._epoch()
+            self.client.push_sparse_grad(self.table, ids_np, grads)
+            fresh = np.asarray(self.client.pull_sparse(self.table, uniq),
+                               np.float32)
+            # pushed ids leave the host tier: the device copy is now the
+            # freshest cached one, and a later demotion re-parks it
+            for i in uniq:
+                self._host.pop(int(i), None)
+            if self._epoch() == epoch0:
+                self._touch(self._insert_device(uniq, fresh))
 
-    def invalidate(self):
+    # --------------------------------------------------------------- admin
+    def _clear_tiers(self):
+        """Caller holds self._lock."""
         self.dev = DeviceHashTable(self.dev.capacity, self.dev.dim,
                                    self.dev.max_probes)
+        self._lru.clear()
+        self._host.clear()
+
+    def invalidate(self):
+        """Drop BOTH tiers (membership change / external writer). Every
+        next pull re-reads through the sharded PS."""
+        with self._lock:
+            self._invalidate_pending = False
+            self._valid_epoch = self._epoch()
+            self._clear_tiers()
+        _monitor.stat_add("ps.heter.invalidations")
         return self

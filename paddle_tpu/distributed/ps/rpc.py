@@ -105,11 +105,11 @@ def set_fault_injector(injector):
     _fault_injector = injector
 
 
-def _fault(side, event, method):
+def _fault(side, event, method, endpoint=None):
     inj = _fault_injector
     if inj is None:
         return None
-    return inj.on_event(side, event, method)
+    return inj.on_event(side, event, method, endpoint)
 
 
 # --- restricted deserialization ------------------------------------------
@@ -274,7 +274,7 @@ class Connection:
             # testing/faults.py PARTITION boundary: a scripted dead or
             # partitioned endpoint refuses the dial without any real
             # process being killed
-            _fault("client", "dial", self.endpoint)
+            _fault("client", "dial", self.endpoint, self.endpoint)
         except ConnectionRefusedError as e:
             raise ConnectRefused(
                 f"ps rpc: endpoint {self.endpoint} refused connection "
@@ -399,9 +399,9 @@ class Connection:
                         self._dial(timeout)
                         _monitor.stat_add("ps.rpc.reconnects")
                     self._sock.settimeout(timeout)
-                    _fault("client", "send", method)
+                    _fault("client", "send", method, self.endpoint)
                     self._sock.sendall(frame)
-                    _fault("client", "recv", method)
+                    _fault("client", "recv", method, self.endpoint)
                     reply = recv_msg(self._sock)
                     if reply is None:
                         raise ConnectionError("peer closed connection")

@@ -11,6 +11,17 @@ at four boundaries:
     ("server", "reply", method)  after the handler ran AND the replay
                                  cache committed, before the reply frame
 
+Client-side events additionally carry the peer ENDPOINT, so a rule can
+target one shard server across every method: `Fault("client", "send",
+STALL, endpoint="127.0.0.1:7001", times=10**9, delay=0.05)` is a
+LATENCY-SKEW rule — that one server is slow (every call to it stalls),
+the rest of the cluster is healthy. Slow-shard is a different failure
+mode than dead-shard: nothing retries, nothing fails over; the tail
+latency just lands on whoever waits for that shard synchronously — the
+prefetch stage exists to absorb exactly this (tests/
+test_ps_sharded_embedding.py proves it absorbs it WITHOUT changing
+results).
+
 An injector decides per event whether to fault. Faults are either
 SCRIPTED — an ordered list of `Fault` rules with after/times counters, so
 a test can say "drop exactly the first push_sparse_grad reply" — or
@@ -91,25 +102,34 @@ class Fault:
 
     side/event: which boundary ('client'/'send', 'client'/'recv',
     'server'/'reply'). method: exact RPC method name, or None for any.
+    endpoint: restrict a CLIENT-side rule to calls against one peer
+    ("host:port") — the per-endpoint latency-skew/slow-shard hook;
+    None matches any peer (server-side events carry no endpoint).
     after: let that many matching frames through first. times: how many
     matches fire (then the rule is spent). delay: STALL sleep seconds.
     """
 
     def __init__(self, side, event, action, method=None, after=0, times=1,
-                 delay=1.0):
+                 delay=1.0, endpoint=None):
         if not _eligible(action, side, event):
             raise ValueError(
                 f"action {action!r} is only injectable at server/reply")
+        if endpoint is not None and side != "client":
+            raise ValueError("endpoint= targeting only exists client-side "
+                             "(the server does not know who dialed it)")
         self.side, self.event, self.action = side, event, action
         self.method, self.after, self.times = method, int(after), int(times)
+        self.endpoint = endpoint
         self.delay = float(delay)
         self._seen = 0
         self._fired = 0
 
-    def _try_fire(self, side, event, method):
+    def _try_fire(self, side, event, method, endpoint=None):
         if side != self.side or event != self.event:
             return False
         if self.method is not None and method != self.method:
+            return False
+        if self.endpoint is not None and endpoint != self.endpoint:
             return False
         self._seen += 1
         if self._seen <= self.after or self._fired >= self.times:
@@ -156,7 +176,7 @@ class FaultInjector:
                 return action
         return None
 
-    def on_event(self, side, event, method):
+    def on_event(self, side, event, method, endpoint=None):
         # system frames are never faulted: auth is part of (re)dialing,
         # ping is the health probe the harness itself relies on
         if method in ("__auth__", "__ping__"):
@@ -164,7 +184,7 @@ class FaultInjector:
         with self._lock:
             action = None
             for f in self.faults:
-                if f._try_fire(side, event, method):
+                if f._try_fire(side, event, method, endpoint):
                     action = f.action
                     delay = f.delay
                     break

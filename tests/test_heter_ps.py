@@ -89,3 +89,132 @@ def test_heter_cache_duplicate_grad_merge(ps):
     after = np.asarray(ps.pull_sparse("emb", np.array([20, 21])))
     np.testing.assert_allclose(after[0], before[0] - 3.0, rtol=1e-5)
     np.testing.assert_allclose(after[1], before[1] - 5.0, rtol=1e-5)
+
+
+def _stat(name):
+    from paddle_tpu.core import monitor
+    return monitor.stat_get(name)
+
+
+def test_device_hashtable_remove_then_reinsert():
+    t = DeviceHashTable(capacity=32, dim=2)
+    ids = np.arange(6, dtype=np.int64) * 32      # force probe collisions
+    t.insert(ids, np.arange(12, dtype=np.float32).reshape(6, 2))
+    t.remove(ids[:2])
+    got, found = t.lookup(ids)
+    assert list(np.asarray(found)) == [False, False, True, True, True, True]
+    assert len(t) == 4
+    # re-inserting a key that still sits PAST a removed hole must update
+    # the existing slot, not create a duplicate in the hole
+    t.insert(ids[2:3], np.full((1, 2), 42.0, np.float32))
+    got, found = t.lookup(ids[2:3])
+    np.testing.assert_allclose(np.asarray(got)[0], 42.0)
+    t.remove(ids[2:3])
+    got, found = t.lookup(ids[2:3])
+    assert not bool(np.asarray(found)[0])        # no stale duplicate
+
+
+def test_heter_cache_lru_evicts_to_host_tier(ps):
+    cache = HeterPSCache(ps, "emb", dim=4, capacity=4, host_rows=8)
+    first = np.arange(4, dtype=np.int64)
+    rows_first, _ = cache.pull(first)
+    ev0, hh0 = _stat("ps.heter.evictions"), _stat("ps.heter.host_hits")
+    cache.pull(np.arange(4, 8, dtype=np.int64))  # evicts the first 4
+    assert _stat("ps.heter.evictions") - ev0 == 4
+    assert len(cache) == 4 and cache.host_len == 4
+    # evicted ids come back from the HOST tier: correct values, no PS RPC
+    rpcs0 = _stat("ps.client.pull_rpcs")
+    rows_again, _ = cache.pull(first)
+    assert _stat("ps.client.pull_rpcs") == rpcs0
+    assert _stat("ps.heter.host_hits") - hh0 == 4
+    np.testing.assert_array_equal(np.asarray(rows_again),
+                                  np.asarray(rows_first))
+    np.testing.assert_array_equal(
+        np.asarray(rows_again), np.asarray(ps.pull_sparse("emb", first)))
+
+
+def test_heter_cache_host_tier_disabled_goes_to_ps(ps):
+    cache = HeterPSCache(ps, "emb", dim=4, capacity=2, host_rows=0)
+    cache.pull(np.array([1, 2], np.int64))
+    cache.pull(np.array([3, 4], np.int64))       # 1, 2 evicted, dropped
+    assert cache.host_len == 0
+    m0 = _stat("ps.heter.misses")
+    rows, _ = cache.pull(np.array([1], np.int64))
+    assert _stat("ps.heter.misses") - m0 == 1    # re-read through the PS
+    np.testing.assert_array_equal(
+        np.asarray(rows), np.asarray(ps.pull_sparse("emb", [1])))
+
+
+def test_heter_cache_push_keeps_tiers_coherent(ps):
+    """A pushed id must never be served from a pre-push host-tier copy:
+    push refreshes the device tier and drops the host copy."""
+    cache = HeterPSCache(ps, "emb", dim=4, capacity=2, host_rows=8)
+    cache.pull(np.array([30, 31], np.int64))
+    cache.pull(np.array([32, 33], np.int64))     # 30, 31 -> host tier
+    assert cache.host_len == 2
+    cache.push_grad(np.array([30], np.int64),
+                    np.ones((1, 4), np.float32))
+    rows, _ = cache.pull(np.array([30], np.int64))
+    np.testing.assert_array_equal(
+        np.asarray(rows), np.asarray(ps.pull_sparse("emb", [30])))
+
+
+def test_heter_cache_empty_push_is_noop(ps):
+    cache = HeterPSCache(ps, "emb", dim=4, capacity=16)
+    cache.push_grad(np.zeros((0,), np.int64), np.zeros((0, 4), np.float32))
+    assert len(cache) == 0          # same no-op contract as the client
+
+
+def test_promoted_backup_rows_repulled_never_stale():
+    """ISSUE 12 satellite: rows cached before a failover promotion are
+    INVALIDATED by the shard-map adoption — the next pull re-reads from
+    the promoted backup instead of serving the stale cached copy."""
+    import time
+
+    from paddle_tpu.core import monitor
+    from paddle_tpu.distributed.ps import ShardMap
+
+    spec = {"emb": {"type": "sparse", "dim": 4, "optimizer": "sgd",
+                    "lr": 1.0, "init": "uniform", "seed": 3}}
+    fast = dict(timeout=5.0, max_retries=2, backoff_base=0.01,
+                backoff_max=0.05)
+    servers = [PSServer("127.0.0.1:0", dict(spec)) for _ in range(2)]
+    eps = [s.start() for s in servers]
+    smap = ShardMap.create(eps, n_backups=1)
+    for s in servers:
+        s.enable_replication(shard_map=smap, peers=eps, n_backups=1,
+                             heartbeat_s=0.1, heartbeat_timeout_s=0.7,
+                             rpc_opts=dict(fast))
+    client_a = PSClient(eps, **fast)
+    client_b = PSClient(eps, **fast)
+    cache = HeterPSCache(client_a, "emb", dim=4, capacity=64)
+    try:
+        ids = np.array([0], np.int64)            # shard 0: primary 0
+        cached, _ = cache.pull(ids)
+        # an INVISIBLE writer updates the row (cache can't see it)...
+        client_b.push_sparse_grad("emb", ids, np.ones((1, 4), np.float32))
+        fresh_value = np.asarray(client_b.pull_sparse("emb", ids))
+        assert not np.array_equal(np.asarray(cached), fresh_value)
+        # ...then the primary dies permanently and the backup promotes
+        servers[0].shutdown()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                eps[0] in servers[1].replica.shard_map.servers:
+            time.sleep(0.05)
+        assert eps[0] not in servers[1].replica.shard_map.servers
+        inv0 = monitor.stat_get("ps.heter.invalidations")
+        # ANY traffic that re-routes adopts the new map; the adoption
+        # pends an invalidation that applies before the next row is read
+        cache.pull(np.array([7], np.int64))      # miss -> RPC -> adopt
+        rows, _ = cache.pull(ids)                # must NOT be the hit
+        assert monitor.stat_get("ps.heter.invalidations") - inv0 >= 1
+        np.testing.assert_array_equal(np.asarray(rows), fresh_value)
+    finally:
+        cache_closers = (client_a, client_b)
+        for c in cache_closers:
+            try:
+                c.close()
+            except Exception:
+                pass
+        for s in servers:
+            s.shutdown()

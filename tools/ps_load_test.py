@@ -19,11 +19,19 @@ Modes (env):
                         outage. Workers must finish with zero errors —
                         the live proof behind docs/fault_tolerance.md's
                         storage-tier section.
+  PS_LOAD_SHARDED=1     sharded-embedding drill: workers train through
+                        the FULL engine — batched deduped cross-shard
+                        lookups, the tiered HeterPS LRU cache, and the
+                        async prefetch stage — against a 3-shard-server
+                        / 1-backup cluster, with one shard primary
+                        killed mid-run. Reports per-shard rows/s, cache
+                        hit rate, prefetch overlap ratio, and promotion
+                        latency; zero worker errors required.
 
 framework_lint TOOL_CROSS_CHECKS runs self_check() here: the
-PADDLE_PS_REPLICA_*/PADDLE_PS_HEARTBEAT_*/PADDLE_PS_FAILOVER_* flag
-defaults, this tool's failover-mode knobs, and docs/fault_tolerance.md
-must agree.
+PADDLE_PS_REPLICA_*/PADDLE_PS_HEARTBEAT_*/PADDLE_PS_FAILOVER_* +
+PADDLE_PS_{FANOUT,PREFETCH,HETER}* flag defaults, this tool's
+failover/sharded-mode knobs, and docs/fault_tolerance.md must agree.
 """
 import os
 import sys
@@ -51,6 +59,10 @@ FAILOVER_SERVERS = int(os.environ.get("PS_LOAD_SERVERS", 3))
 FAILOVER_HB_S = float(os.environ.get("PS_LOAD_HB_S", 0.1))
 FAILOVER_HB_TIMEOUT_S = float(os.environ.get("PS_LOAD_HB_TIMEOUT_S", 0.7))
 
+# sharded-embedding-drill cache bound (PS_LOAD_SHARDED mode): small
+# enough that the random workload exercises LRU eviction + the host tier
+SHARDED_CACHE_ROWS = int(os.environ.get("PS_LOAD_CACHE_ROWS", 8192))
+
 # flag defaults this tool (and the docs flag table) are written against;
 # drift here means docs/fault_tolerance.md + this header need an update
 REPLICA_FLAG_DEFAULTS = {
@@ -61,6 +73,11 @@ REPLICA_FLAG_DEFAULTS = {
     "PADDLE_PS_HEARTBEAT_TIMEOUT_S": 3.0,
     "PADDLE_PS_FAILOVER_RETRIES": 8,
     "PADDLE_PS_FAILOVER_BACKOFF_S": 0.25,
+    # sharded embedding engine (PS_LOAD_SHARDED drill)
+    "PADDLE_PS_FANOUT_THREADS": 4,
+    "PADDLE_PS_PREFETCH_DEPTH": 2,
+    "PADDLE_PS_HETER_CACHE_ROWS": 65536,
+    "PADDLE_PS_HETER_HOST_ROWS": 262144,
 }
 
 
@@ -166,6 +183,131 @@ def run_failover():
     return 0
 
 
+def run_sharded():
+    """PS_LOAD_SHARDED: the full sharded-embedding engine under load +
+    a kill-one-shard-primary drill. Workers pull through
+    EmbeddingPrefetcher -> HeterPSCache -> PSClient's cross-shard
+    fan-out and push merged grads back; shard 0's primary dies mid-run.
+    Reports per-shard rows/s, cache hit rate, prefetch overlap ratio,
+    promotion latency, and the replica counters."""
+    from paddle_tpu.core import monitor
+    from paddle_tpu.distributed.ps import (EmbeddingPrefetcher,
+                                           HeterPSCache, ShardMap)
+
+    spec = {"emb": {"type": "sparse", "dim": DIM, "optimizer": "sgd",
+                    "lr": 0.1, "init": "uniform", "seed": 7}}
+    servers = [PSServer("127.0.0.1:0", dict(spec))
+               for _ in range(FAILOVER_SERVERS)]
+    eps = [s.start() for s in servers]
+    smap = ShardMap.create(eps, n_backups=1)
+    fast = dict(timeout=5.0, max_retries=2, backoff_base=0.01,
+                backoff_max=0.05)
+    for s in servers:
+        s.enable_replication(shard_map=smap, peers=eps, n_backups=1,
+                             heartbeat_s=FAILOVER_HB_S,
+                             heartbeat_timeout_s=FAILOVER_HB_TIMEOUT_S,
+                             rpc_opts=dict(fast))
+
+    errors = []
+    results = {}
+
+    def worker(wid):
+        client = PSClient(eps, **fast)
+        cache = HeterPSCache(client, "emb", DIM,
+                             capacity=SHARDED_CACHE_ROWS)
+        pf = EmbeddingPrefetcher(cache)
+        rng = np.random.RandomState(wid)
+        batches = [np.unique(rng.randint(0, VOCAB, BATCH_IDS)
+                             .astype(np.int64)) for _ in range(ROUNDS)]
+        pulled = 0
+        # per-worker shard tally, merged after join — a shared
+        # read-modify-write across worker threads would lose updates
+        my_shard_rows = np.zeros(FAILOVER_SERVERS, np.int64)
+        t0 = time.perf_counter()
+        try:
+            pf.prefetch(batches[0])
+            for r in range(ROUNDS):
+                ids = batches[r]
+                rows = pf.get(ids)
+                if r + 1 < ROUNDS:
+                    pf.prefetch(batches[r + 1])
+                pulled += len(ids)
+                my_shard_rows += np.bincount(ids % FAILOVER_SERVERS,
+                                             minlength=FAILOVER_SERVERS)
+                pf.push_grad(ids, np.asarray(rows, np.float32) * 0 + 0.01)
+        except Exception as e:  # noqa: BLE001 — reported below
+            errors.append(f"worker {wid}: {type(e).__name__}: {e}")
+        finally:
+            stats = pf.stats()
+            try:
+                pf.close()
+            except Exception:
+                pass
+            client.close()
+        results[wid] = (pulled, time.perf_counter() - t0, stats,
+                        my_shard_rows)
+
+    promotions0 = monitor.stat_get("ps.replica.promotions")
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(WORKERS)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    t_kill = time.perf_counter()
+    servers[0].shutdown()                 # permanent shard-primary kill
+    promote_latency = None
+    while time.perf_counter() - t_kill < 30.0:
+        if monitor.stat_get("ps.replica.promotions") > promotions0:
+            promote_latency = time.perf_counter() - t_kill
+            break
+        time.sleep(0.005)
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    for s in servers[1:]:
+        s.shutdown()
+
+    total = sum(r[0] for r in results.values())
+    shard_rows = np.sum([r[3] for r in results.values()], axis=0) \
+        if results else np.zeros(FAILOVER_SERVERS, np.int64)
+    hits = monitor.stat_get("ps.heter.hits")
+    host_hits = monitor.stat_get("ps.heter.host_hits")
+    misses = monitor.stat_get("ps.heter.misses")
+    hit_rate = (hits + host_hits) / max(1, hits + host_hits + misses)
+    overlaps = [r[2]["overlap_ratio"] for r in results.values()
+                if r[2].get("pull_s")]
+    print(f"sharded-embedding drill: {FAILOVER_SERVERS} shard servers, "
+          f"1 backup each, {WORKERS} workers x {ROUNDS} rounds, shard-0 "
+          "primary killed at 0.5s")
+    print(f"promotion latency: "
+          f"{'NONE RECORDED' if promote_latency is None else f'{promote_latency * 1000:.0f}ms'}"
+          f" (heartbeat {FAILOVER_HB_S}s, deadline "
+          f"{FAILOVER_HB_TIMEOUT_S}s)")
+    print(f"rows pulled through the engine: {total:,} "
+          f"({total / wall:,.0f} rows/sec aggregate)")
+    for s in range(FAILOVER_SERVERS):
+        print(f"  shard {s}: {int(shard_rows[s]):,} rows "
+              f"({shard_rows[s] / wall:,.0f} rows/sec)")
+    print(f"cache hit rate: {hit_rate:.1%} "
+          f"(device {hits:,} + host {host_hits:,} hits, {misses:,} "
+          "PS misses)")
+    if overlaps:
+        print(f"prefetch overlap ratio: {sum(overlaps) / len(overlaps):.2f}"
+              f" (mean across {len(overlaps)} workers)")
+    replica = {k: int(v) for k, v in
+               sorted(monitor.stats("ps.replica.").items())}
+    print(f"replica counters: {replica}")
+    if errors:
+        print("worker errors:\n  " + "\n  ".join(errors))
+        return 1
+    if promote_latency is None:
+        print("ERROR: no promotion was recorded")
+        return 1
+    print("all workers finished with zero errors")
+    return 0
+
+
 def self_check():
     """framework_lint cross-check: flag defaults <-> this tool's knobs
     <-> docs/fault_tolerance.md. Returns a list of violations."""
@@ -197,6 +339,10 @@ def self_check():
     if "PS_LOAD_FAILOVER" not in doc:
         problems.append("ps_load_test: the PS_LOAD_FAILOVER drill is not "
                         "documented in docs/fault_tolerance.md")
+    if "PS_LOAD_SHARDED" not in doc:
+        problems.append("ps_load_test: the PS_LOAD_SHARDED sharded-"
+                        "embedding drill is not documented in "
+                        "docs/fault_tolerance.md")
     for token in (f"heartbeat_s={FAILOVER_HB_S}",
                   f"heartbeat_timeout_s={FAILOVER_HB_TIMEOUT_S}"):
         if token not in doc:
@@ -208,6 +354,8 @@ def self_check():
 
 
 def main():
+    if os.environ.get("PS_LOAD_SHARDED"):
+        return run_sharded()
     if os.environ.get("PS_LOAD_FAILOVER"):
         return run_failover()
     srv = PSServer(tables={
