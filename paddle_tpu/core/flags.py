@@ -360,3 +360,31 @@ define_flag("PADDLE_ELASTIC_HEARTBEAT_TIMEOUT_S", 60.0,
             "supervisor-side liveness deadline: a trainer whose "
             "heartbeat file is older than this (or unreadable) is "
             "declared dead and restarted")
+
+# --- online learning (dataset/streaming.py, static/executor.py online
+# --- mode, distributed/ps/publish.py, inference/serving.py hot-swap) ---
+define_flag("PADDLE_STREAM_QUEUE_CAP", 1024,
+            "bounded-queue capacity of dataset/streaming.StreamingDataset: "
+            "producers (ServeLoop completion hooks) block in offer() once "
+            "this many undelivered records are buffered — backpressure "
+            "toward the serving tier instead of unbounded memory growth")
+define_flag("PADDLE_STREAM_DEDUPE_WINDOW", 4096,
+            "record-id dedupe window of StreamingDataset: the ids of the "
+            "last N accepted records are remembered and re-offers of any "
+            "of them are rejected (at-least-once transport in, exactly-"
+            "once training batches out). The window rides checkpoints "
+            "(state_dict), so a restarted trainer keeps rejecting "
+            "records it already trained on")
+define_flag("PADDLE_ONLINE_SYNC_EVERY", 1,
+            "flush cadence of the online (continuous Downpour) trainer "
+            "mode in static/executor.py: accumulated sparse deltas are "
+            "pushed to the PS via push_sparse_delta every this many "
+            "batches — one replay-id-protected RPC per touched shard "
+            "per flush")
+define_flag("PADDLE_ONLINE_STALENESS_BATCHES", 4,
+            "bounded-staleness knob of the online trainer: the hard "
+            "bound on batches trained past the last SUCCESSFUL delta "
+            "flush. A transiently failing flush (PS chaos, failover in "
+            "progress) is retried next cadence until this bound, then "
+            "the flush error propagates (fail-stop) rather than letting "
+            "the served model fall arbitrarily behind")

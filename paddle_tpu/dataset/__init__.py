@@ -158,3 +158,10 @@ movielens.train = lambda data_file=None: _movielens_reader("train",
                                                            data_file)
 movielens.test = lambda data_file=None: _movielens_reader("test",
                                                           data_file)
+
+
+# -- streaming: online-learning completion-record stream (a REAL
+# -- submodule, not a fluid reader shim — see docs/online_learning.md) ------
+from .streaming import StreamingDataset  # noqa: E402
+
+__all__ += ["streaming", "StreamingDataset"]

@@ -24,6 +24,7 @@ import numpy as np
 from .client import Communicator, PSClient
 from .embedding import EmbeddingPrefetcher
 from .heter import DeviceHashTable, HeterPSCache
+from .publish import EmbeddingSnapshotPublisher
 from .replica import ReplicaManager
 from .rpc import AuthError, ConnectRefused, DeadlineExceeded, FrameError
 from .server import PSServer
@@ -34,7 +35,7 @@ from .table import (BarrierTable, DenseTable, GeoSparseTable, SparseTable,
 __all__ = ["PSServer", "PSClient", "Communicator", "DenseTable",
            "SparseTable", "GeoSparseTable", "BarrierTable", "make_table",
            "SparseEmbedding", "DeviceHashTable", "HeterPSCache",
-           "EmbeddingPrefetcher",
+           "EmbeddingPrefetcher", "EmbeddingSnapshotPublisher",
            "DeadlineExceeded", "FrameError", "AuthError", "ConnectRefused",
            "ShardMap", "ShardMapStale", "ReplicaManager"]
 

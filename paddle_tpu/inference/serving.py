@@ -31,10 +31,26 @@ or whether it was preempted. Greedy (temperature=0) continuous-batched
 decode is token-identical to per-request sequential `GPT.generate`
 (tests/test_serving.py proves it bitwise).
 
-Observability: spans `serve/{admit,prefill,decode_step,retire,evict}`
-with a per-request flow chain, gauges `serve.{queue_depth,active_slots,
-kv_pool_used_blocks,kv_pool_free_blocks}`, counters `serve.{preempted,
-tokens_generated,requests_completed,requests_errored}`, histograms
+Two seams close the serve→train→serve loop (docs/online_learning.md):
+
+- **completion records**: every request that finishes cleanly emits a
+  structured record (id, prompt/generated ids, pinned snapshot version,
+  ttft/per-token timings) through the `on_complete` hook at retire —
+  the input contract of `dataset/streaming.StreamingDataset`. A hook
+  error is counted (`serve.completion_log_errors`) and swallowed; a
+  logging bug never fails serving.
+- **zero-downtime hot-swap**: `publish_weights(version, updates)`
+  stages a versioned weight swap; the scheduler applies it between
+  decode beats once every in-flight stream has retired. While a swap
+  is staged admission pauses — queued requests WAIT (nothing is ever
+  dropped) and each in-flight stream finishes on the version pinned at
+  its first admission.
+
+Observability: spans `serve/{admit,prefill,decode_step,retire,evict,
+hot_swap}` with a per-request flow chain, gauges `serve.{queue_depth,
+active_slots,kv_pool_used_blocks,kv_pool_free_blocks,model_version}`,
+counters `serve.{preempted,tokens_generated,requests_completed,
+requests_errored,hot_swaps,completion_log_errors}`, histograms
 `serve/ttft_ms` and `serve/token_ms` — rendered by tools/obs_report.py's
 serving section and snapshotted by BENCH_MODE=serve.
 """
@@ -52,9 +68,11 @@ __all__ = ["ServeConfig", "ServeRequest", "ServeLoop",
            "build_decode_step"]
 
 GAUGES = ("serve.queue_depth", "serve.active_slots",
-          "serve.kv_pool_used_blocks", "serve.kv_pool_free_blocks")
+          "serve.kv_pool_used_blocks", "serve.kv_pool_free_blocks",
+          "serve.model_version")
 COUNTERS = ("serve.preempted", "serve.tokens_generated",
-            "serve.requests_completed", "serve.requests_errored")
+            "serve.requests_completed", "serve.requests_errored",
+            "serve.hot_swaps", "serve.completion_log_errors")
 
 _REQ_IDS = itertools.count()
 
@@ -112,6 +130,7 @@ class ServeRequest:
         self.out = []            # generated token ids (host ints)
         self.error = None
         self.preemptions = 0
+        self.snapshot_version = None  # model version pinned at 1st admit
         self.t_submit = time.perf_counter()
         self.t_first = None      # first generated token materialized
         self.t_done = None
@@ -145,6 +164,24 @@ class ServeRequest:
         if self.t_done is None or self.t_first is None or len(self.out) < 2:
             return None
         return (self.t_done - self.t_first) / (len(self.out) - 1)
+
+    # -- completion record ---------------------------------------------------
+    def completion_record(self):
+        """Structured retire-time record — the StreamingDataset input
+        contract (docs/online_learning.md). Host ints/floats only, so
+        records serialize/queue without holding device buffers."""
+        return {
+            "rid": int(self.rid),
+            "prompt": [int(t) for t in self.prompt.tolist()],
+            "tokens": [int(t) for t in self.out],
+            "version": self.snapshot_version,
+            "preemptions": int(self.preemptions),
+            "t_submit": self.t_submit,
+            "t_first": self.t_first,
+            "t_done": self.t_done,
+            "ttft_s": self.ttft_s,
+            "per_token_s": self.per_token_s,
+        }
 
 
 def _sampler(temperature, top_k):
@@ -249,7 +286,7 @@ class ServeLoop:
     client threads `submit(...).result()`. `stop()` drains and joins.
     """
 
-    def __init__(self, net, config=None, **overrides):
+    def __init__(self, net, config=None, on_complete=None, **overrides):
         import jax
         import jax.numpy as jnp
 
@@ -294,6 +331,9 @@ class ServeLoop:
         self._slots = [None] * self._A
         self._queue: deque = deque()
         self._pending: deque = deque()  # settle entries, driver order
+        self._on_complete = on_complete  # completion-record hook
+        self.model_version = 0           # published weight version
+        self._staged_swap = None         # (version, {name: np rows})
         self._version = 0
         self._admit_seq = 0
         self._step_count = 0
@@ -377,11 +417,40 @@ class ServeLoop:
             "steps": self._step_count,
             "block_size": self._bs,
             "max_active": self._A,
+            "model_version": self.model_version,
+            "swap_staged": self._staged_swap is not None,
         }
+
+    def publish_weights(self, version, updates):
+        """Stage a versioned weight hot-swap: `updates` maps functional-
+        state param names (see `net.functional_state()`) to replacement
+        arrays. Validated (name + shape) on the caller thread; APPLIED
+        by the scheduler between decode beats once every in-flight
+        stream has retired. While a swap is staged, admission pauses —
+        queued requests wait (the pool never drops a request) and each
+        in-flight stream finishes on the version pinned at its first
+        admission. Staging a second swap before the first applies
+        replaces it (last publish wins). Thread-safe."""
+        staged = {}
+        for name, arr in dict(updates).items():
+            if name not in self._params:
+                raise KeyError(f"unknown param {name!r} "
+                               f"(not in functional_state)")
+            arr = np.asarray(arr)
+            want = tuple(self._params[name].shape)
+            if tuple(arr.shape) != want:
+                raise ValueError(f"shape {tuple(arr.shape)} for "
+                                 f"{name!r} != served {want}")
+            staged[name] = arr
+        with self._work:
+            self._staged_swap = (int(version), staged)
+            self._work.notify_all()
+        return self
 
     # -- scheduler ----------------------------------------------------------
     def _has_work(self):
         return bool(self._queue or self._pending
+                    or self._staged_swap is not None
                     or any(s is not None for s in self._slots))
 
     def _serve_forever(self):
@@ -399,6 +468,19 @@ class ServeLoop:
         step (N+1 overlapping the settle of step N)."""
         while len(self._pending) >= self._max_inflight:
             self._settle_one()
+        if self._staged_swap is not None:
+            # drain barrier: no admission while a swap is staged —
+            # active streams run to retirement on the pinned version,
+            # then the swap applies and admission resumes
+            if any(s is not None for s in self._slots):
+                self._grow_or_preempt()
+                self._dispatch_decode()
+            elif self._pending:
+                self._settle_one()
+            else:
+                self._apply_swap()
+            self._publish_gauges()
+            return
         self._admit()
         if any(s is not None for s in self._slots):
             self._grow_or_preempt()
@@ -411,6 +493,27 @@ class ServeLoop:
         while self._pending:
             self._settle_one()
         self._publish_gauges()
+
+    def _apply_swap(self):
+        """The hot-swap itself, between beats with nothing in flight:
+        rebind the published params in the functional state. No arena /
+        block state is touched — the KV pool is version-agnostic (only
+        FUTURE prefills/decodes read the new weights, and the drain
+        barrier guarantees there are no other kind)."""
+        import jax.numpy as jnp
+
+        from ..core import monitor as _monitor
+        from ..core import trace as _trace
+        version, updates = self._staged_swap
+        self._staged_swap = None
+        with _trace.span("serve/hot_swap", version=version,
+                         params=len(updates)):
+            for name, arr in updates.items():
+                self._params[name] = jnp.asarray(
+                    arr, self._params[name].dtype)
+            self.net.load_functional_state(self._params, self._buffers)
+            self.model_version = int(version)
+            _monitor.stat_add("serve.hot_swaps")
 
     # -- admission / prefill -------------------------------------------------
     def _free_slot(self):
@@ -447,6 +550,8 @@ class ServeLoop:
                              blocks=len(blocks)) as sp:
                 sp.flow(self._flow_base + req.rid, "s")
                 import jax
+                if req.snapshot_version is None:
+                    req.snapshot_version = self.model_version
                 self._version += 1
                 self._admit_seq += 1
                 key = np.asarray(jax.random.PRNGKey(req.seed),
@@ -652,6 +757,14 @@ class ServeLoop:
                 _monitor.observe("serve/ttft_ms", req.ttft_s * 1e3)
             if req.per_token_s is not None:
                 _monitor.observe("serve/token_ms", req.per_token_s * 1e3)
+            if self._on_complete is not None:
+                # the record is emitted BEFORE the future resolves, so
+                # a client that saw result() knows its record was
+                # offered; a hook error never fails serving
+                try:
+                    self._on_complete(req.completion_record())
+                except Exception:
+                    _monitor.stat_add("serve.completion_log_errors")
             req._done.set()
 
     def _fail_inflight(self, exc):
@@ -689,4 +802,5 @@ class ServeLoop:
                                       for s in self._slots),
             "serve.kv_pool_used_blocks": self._pool.used_blocks,
             "serve.kv_pool_free_blocks": self._pool.free_blocks,
+            "serve.model_version": self.model_version,
         })

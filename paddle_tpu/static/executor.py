@@ -368,6 +368,14 @@ class Executor:
         are EXCLUDED from the program's local optimizer section — the
         server's accessor owns the update rule.
 
+        ps_config {"mode": "online", ...} switches to the CONTINUOUS
+        Downpour variant (docs/online_learning.md): params keep the
+        LOCAL optimizer and accumulated deltas flow to a "geo_sparse"
+        table via replay-keyed push_sparse_delta every "sync_every"
+        batches under the PADDLE_ONLINE_STALENESS_BATCHES bound —
+        feed it a dataset/streaming.StreamingDataset to train from
+        live serving traffic.
+
         start_batch resumes mid-epoch at the exact batch: the first N
         batches are skipped (at the dataset's index level when it
         supports batches(start_batch=...), by islice otherwise) and step
@@ -700,13 +708,36 @@ class _DownpourDriver:
     the step those rows of its gradient are pushed back (optionally via
     the async Communicator). The param is removed from the local optimizer
     section — the server-side accessor (sgd/adagrad/adam) owns the update,
-    exactly the reference's division of labor."""
+    exactly the reference's division of labor.
+
+    mode="online" is the CONTINUOUS Downpour/Geo variant that closes the
+    serve→train loop (docs/online_learning.md): the param KEEPS its local
+    optimizer (the worker applies its own update rule, reference
+    GeoCommunicator), and what flows to the server is the accumulated
+    LOCAL DELTA — pushed via `push_sparse_delta` against a "geo_sparse"
+    table every `sync_every` batches. Each cut payload carries a stable
+    request key (trainer id + flush sequence), so a flush retried across
+    transport faults, server failover, or even a trainer restart (with
+    the client's replay state restored) applies EXACTLY ONCE. A failing
+    flush is deferred and retried at the next cadence up to the bounded-
+    staleness knob (PADDLE_ONLINE_STALENESS_BATCHES), then the error
+    propagates — fail-stop beats serving an arbitrarily stale model.
+    Per-spec "prefetcher" (PR 12 EmbeddingPrefetcher) routes pulls
+    through the prefetch/conflict machinery and gets `note_pushed` after
+    every acked flush. `flush_log` records every cut payload
+    (spec, seq, ids) — the deterministic schedule exactly-once drills
+    replay against per-server `table_applied`."""
 
     def __init__(self, program, scope, ps_config):
         from .program import global_scope
         self.scope = scope or global_scope()
         self.client = ps_config["client"]
         self.comm = ps_config.get("communicator")
+        self.mode = ps_config.get("mode", "sync")
+        if self.mode not in ("sync", "online"):
+            raise ValueError(f"ps_config mode {self.mode!r} "
+                             f"(want 'sync' or 'online')")
+        self.online = self.mode == "online"
         self.specs = [dict(s) for s in ps_config.get("sparse", [])]
         for s in self.specs:
             target = s["param"]
@@ -723,31 +754,77 @@ class _DownpourDriver:
             s["_name"] = pv.name
             s["_scope"] = getattr(pv, "scope_name", pv.name)
         ps_names = {s["_name"] for s in self.specs}
-        if program.optimizer_section:
+        if program.optimizer_section and not self.online:
             opt, pairs = program.optimizer_section
             keep = [(p, g) for p, g in pairs if p.name not in ps_names]
             if len(keep) != len(pairs):
                 program.optimizer_section = (opt, keep)
                 program._version += 1
         self.grad_fetches = []
-        bw = getattr(program, "backward_section", None)
-        bw_pairs = bw[1] if bw else []
-        for s in self.specs:
-            gvar = next((g for p, g in bw_pairs if p.name == s["_name"]),
-                        None)
-            if gvar is None:
-                raise ValueError(
-                    f"ps_config param {s['param']!r} has no grad var — "
-                    "run minimize()/append_backward over it")
-            self.grad_fetches.append(gvar)
+        if not self.online:
+            bw = getattr(program, "backward_section", None)
+            bw_pairs = bw[1] if bw else []
+            for s in self.specs:
+                gvar = next((g for p, g in bw_pairs
+                             if p.name == s["_name"]), None)
+                if gvar is None:
+                    raise ValueError(
+                        f"ps_config param {s['param']!r} has no grad var "
+                        "— run minimize()/append_backward over it")
+                self.grad_fetches.append(gvar)
+        else:
+            from ..core import flags as _flags
+            self.sync_every = int(
+                ps_config.get("sync_every")
+                or _flags.flag("PADDLE_ONLINE_SYNC_EVERY"))
+            self.staleness = max(
+                int(ps_config.get("staleness_batches")
+                    or _flags.flag("PADDLE_ONLINE_STALENESS_BATCHES")),
+                self.sync_every)
+            self.trainer_id = int(ps_config.get("trainer_id", 0))
+            self.on_batch = ps_config.get("on_batch")
+            self._pending = [{} for _ in self.specs]  # id -> delta row
+            self._frozen = [None] * len(self.specs)   # unacked payload
+            self._flush_seq = [0] * len(self.specs)
+            self._unflushed = 0       # batches past last acked flush
+            self._batch_count = 0
+            self.flush_log = []       # (spec_idx, seq, ids) of payloads
+            if ps_config.get("state"):
+                self.load_online_state(ps_config["state"])
         self._pulled = [None] * len(self.specs)
+        self._before = [None] * len(self.specs)
 
     def pre_step(self, feed):
         import jax.numpy as jnp
         for i, s in enumerate(self.specs):
             ids = np.asarray(feed[s["slot"]]).reshape(-1)
             uniq = np.unique(ids.astype(np.int64))
-            rows = self.client.pull_sparse(s["table"], uniq)
+            pf = s.get("prefetcher")
+            if self.online and pf is not None:
+                pf.prefetch(uniq)
+                rows = np.asarray(pf.get(uniq), np.float32)
+            else:
+                rows = np.asarray(
+                    self.client.pull_sparse(s["table"], uniq),
+                    np.float32)
+            if self.online:
+                # local view = server rows + this worker's un-acked
+                # progress (pending accumulation and any frozen payload
+                # still in retry) — Downpour: the worker trains on its
+                # own freshest rows, the server sees deltas at flush
+                rows = rows.copy()
+                pend = self._pending[i]
+                frozen = self._frozen[i]
+                fpos = {} if frozen is None else {
+                    int(x): k for k, x in enumerate(frozen[1])}
+                for j, ident in enumerate(uniq.tolist()):
+                    d = pend.get(ident)
+                    if d is not None:
+                        rows[j] += d
+                    k = fpos.get(ident)
+                    if k is not None:
+                        rows[j] += frozen[2][k]
+                self._before[i] = rows
             w = self.scope.get(s["_scope"])
             self.scope.set(s["_scope"], jnp.asarray(w).at[
                 jnp.asarray(uniq)].set(jnp.asarray(rows, w.dtype)))
@@ -755,13 +832,126 @@ class _DownpourDriver:
         return feed
 
     def post_step(self, grad_outs):
-        for s, uniq, g in zip(self.specs, self._pulled, grad_outs):
-            rows_g = np.asarray(g)[uniq]
-            if self.comm is not None:
-                self.comm.push_sparse(s["table"], uniq, rows_g)
-            else:
-                self.client.push_sparse_grad(s["table"], uniq, rows_g)
+        if not self.online:
+            for s, uniq, g in zip(self.specs, self._pulled, grad_outs):
+                rows_g = np.asarray(g)[uniq]
+                if self.comm is not None:
+                    self.comm.push_sparse(s["table"], uniq, rows_g)
+                else:
+                    self.client.push_sparse_grad(s["table"], uniq,
+                                                 rows_g)
+            return
+        for i, s in enumerate(self.specs):
+            uniq = self._pulled[i]
+            after = np.asarray(self.scope.get(s["_scope"]),
+                               np.float32)[uniq]
+            delta = after - self._before[i]
+            pend = self._pending[i]
+            for j, ident in enumerate(uniq.tolist()):
+                d = pend.get(ident)
+                pend[ident] = delta[j].copy() if d is None \
+                    else d + delta[j]
+        self._unflushed += 1
+        self._batch_count += 1
+        self._maybe_flush()
+        if self.on_batch is not None:
+            self.on_batch(self)
+
+    # -- online (continuous Downpour) flush machinery -----------------------
+    def _maybe_flush(self, force=False):
+        from ..core import monitor as _monitor
+        if not force and self._unflushed < self.sync_every:
+            _monitor.stat_set("ps.online.staleness_batches",
+                              self._unflushed)
+            return
+        try:
+            self._push_all()
+            self._unflushed = 0
+        except (ConnectionError, OSError, RuntimeError):
+            # transient PS trouble (chaos, failover in progress): defer
+            # to the next cadence — but only inside the staleness bound
+            _monitor.stat_add("ps.online.deferred_flushes")
+            if force or self._unflushed >= self.staleness:
+                raise
+        _monitor.stat_set("ps.online.staleness_batches",
+                          self._unflushed)
+
+    def _push_all(self):
+        for i, s in enumerate(self.specs):
+            if self._frozen[i] is not None:
+                # retry the frozen payload FIRST, under its original
+                # request key — if the failed attempt actually applied
+                # server-side, the replay cache swallows this resend
+                seq, fids, fdeltas = self._frozen[i]
+                self._push_payload(s, seq, fids, fdeltas)
+                self._frozen[i] = None
+            pend = self._pending[i]
+            if not pend:
+                continue
+            ids = np.fromiter(sorted(pend), np.int64, len(pend))
+            deltas = np.stack([pend[int(x)] for x in ids])
+            seq = self._flush_seq[i]
+            self._flush_seq[i] += 1
+            # the payload is CUT here: logged once, then pushed under a
+            # stable key until acked — the log IS the delta schedule
+            self.flush_log.append((i, seq,
+                                   tuple(int(x) for x in ids)))
+            self._pending[i] = {}
+            self._frozen[i] = (seq, ids, deltas)
+            self._push_payload(s, seq, ids, deltas)
+            self._frozen[i] = None
+
+    def _push_payload(self, s, seq, ids, deltas):
+        from ..core import monitor as _monitor
+        self.client.push_sparse_delta(
+            s["table"], ids, deltas,
+            request_key=("online", self.trainer_id, int(seq)))
+        pf = s.get("prefetcher")
+        if pf is not None:
+            pf.note_pushed(ids)
+        _monitor.stat_add("ps.online.flushes")
+        _monitor.stat_add("ps.online.delta_rows", len(ids))
+
+    def online_state(self):
+        """Checkpoint payload of the continuous trainer: un-pushed
+        accumulation, any frozen (cut, unacked) payloads with their
+        flush sequence numbers, and the client's replay identity — a
+        restarted trainer restoring this (plus the dataset's
+        state_dict) resumes the EXACT delta schedule, and resent
+        payloads dedupe server-side."""
+        return {
+            "flush_seq": list(self._flush_seq),
+            "unflushed": int(self._unflushed),
+            "batch_count": int(self._batch_count),
+            "pending": [{int(k): v.tolist() for k, v in p.items()}
+                        for p in self._pending],
+            "frozen": [None if f is None else
+                       [int(f[0]), np.asarray(f[1]).tolist(),
+                        np.asarray(f[2]).tolist()] for f in self._frozen],
+            "flush_log": [[i, seq, list(ids)]
+                          for i, seq, ids in self.flush_log],
+            "replay": self.client.replay_state(),
+        }
+
+    def load_online_state(self, state):
+        self._flush_seq = [int(x) for x in state["flush_seq"]]
+        self._unflushed = int(state["unflushed"])
+        self._batch_count = int(state["batch_count"])
+        self._pending = [
+            {int(k): np.asarray(v, np.float32) for k, v in p.items()}
+            for p in state["pending"]]
+        self._frozen = [
+            None if f is None else
+            (int(f[0]), np.asarray(f[1], np.int64),
+             np.asarray(f[2], np.float32)) for f in state["frozen"]]
+        self.flush_log = [(int(i), int(seq), tuple(ids))
+                          for i, seq, ids in state["flush_log"]]
+        self.client.load_replay_state(state["replay"])
 
     def flush(self):
+        if self.online:
+            # end of stream: push everything, fail-stop on error
+            self._maybe_flush(force=True)
+            return
         if self.comm is not None:
             self.comm.flush()

@@ -11,6 +11,13 @@ at four boundaries:
     ("server", "reply", method)  after the handler ran AND the replay
                                  cache committed, before the reply frame
 
+The STREAMING dataset (dataset/streaming.py) consults the same injector
+in front of every batch delivery as ("stream", "deliver", <stream
+name>): a scripted STALL there is a deterministic BACKLOG BURST —
+delivery pauses, the bounded queue fills, watermark/backlog gauges move,
+and nothing is dropped (see `backlog_burst()` below); a RESET there is
+absorbed by the dataset as a transient delivery fault and retried.
+
 Client-side events additionally carry the peer ENDPOINT, so a rule can
 target one shard server across every method: `Fault("client", "send",
 STALL, endpoint="127.0.0.1:7001", times=10**9, delay=0.05)` is a
@@ -73,7 +80,8 @@ import time
 from ..distributed.ps import rpc as _rpc
 
 __all__ = ["RESET", "DROP", "STALL", "GARBLE", "OVERSIZE", "PARTITION",
-           "Fault", "FaultInjector", "inject", "install", "uninstall"]
+           "Fault", "FaultInjector", "backlog_burst", "inject",
+           "install", "uninstall"]
 
 RESET = "reset"
 DROP = "drop"
@@ -212,6 +220,19 @@ class FaultInjector:
         with self._lock:
             return sum(1 for rec in self.log
                        if action is None or rec[3] == action)
+
+
+def backlog_burst(name=None, after=0, times=1, delay=0.2):
+    """Scripted backlog burst for the streaming queue: a STALL rule at
+    the ("stream", "deliver") boundary. Each firing pauses ONE batch
+    delivery for `delay` seconds while producers keep offering — the
+    backlog grows, the watermark holds, and every record is delivered
+    once the burst passes (pause/resume, never drop). `name` targets
+    one StreamingDataset (its `name=`), None matches any; after/times
+    script where in the delivery sequence the burst lands, mirroring
+    the endpoint-targetable STALL used for slow-shard skew."""
+    return Fault("stream", "deliver", STALL, method=name, after=after,
+                 times=times, delay=delay)
 
 
 def install(injector: FaultInjector) -> FaultInjector:

@@ -1,0 +1,710 @@
+"""Online-learning loop (ISSUE 14): serve -> train -> publish -> serve.
+
+The closed loop (docs/online_learning.md) is three seams proven here:
+
+1. ServeLoop emits a structured completion record at retire
+   (ServeRequest.completion_record via on_complete) and a
+   dataset/streaming.StreamingDataset turns the at-least-once record
+   feed into exactly-once training batches relative to its checkpoint
+   cut — dedupe window, bounded queue backpressure, scripted backlog
+   bursts that pause WITHOUT dropping.
+2. The continuous Downpour trainer (static/executor.py ps_config
+   mode="online") accumulates local deltas and pushes them through
+   PSClient.push_sparse_delta under replay-stable request keys — a
+   flush whose ack was lost resends the FROZEN payload under the same
+   key and dedupes server-side, including across a failover re-route to
+   a promoted backup and across a trainer restart that restored the
+   replay identity.
+3. EmbeddingSnapshotPublisher cuts versioned snapshots out of the
+   replica tier's consistent fetch and ServeLoop.publish_weights
+   hot-swaps them between decode beats: in-flight streams finish on the
+   version pinned at first admission, the pool never drops a request.
+
+THE acceptance proof (`test_online_learning_chaos_drill`): live serve
+traffic from a tiny GPT measurably shifts the served model — a
+versioned eval metric strictly decreases across >=3 hot-swapped
+snapshot versions — under seeded RESET+DROP chaos, a PERMANENT mid-run
+shard-primary kill, and a mid-run trainer restart onto a fresh PSClient
+with restored replay identity; per-server `table.applied` matches the
+deterministic flush schedule replayed against the membership timeline
+EXACTLY, and zero serve requests are dropped.
+"""
+import itertools
+import json
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.core import monitor
+from paddle_tpu.dataset import StreamingDataset
+from paddle_tpu.distributed.ps import (EmbeddingPrefetcher,
+                                       EmbeddingSnapshotPublisher,
+                                       HeterPSCache, PSClient, PSServer,
+                                       ShardMap)
+from paddle_tpu.inference import ServeConfig, ServeLoop
+from paddle_tpu.testing import faults
+from paddle_tpu.text.models.gpt import GPT, GPTConfig
+
+HID = 64          # GPTConfig.tiny() hidden size == PS table dim
+VOCAB = 1024      # GPTConfig.tiny() vocab == embedding rows
+
+FAST = dict(timeout=2.0, max_retries=2, backoff_base=0.01,
+            backoff_max=0.05, connect_retry_s=5.0)
+HB = dict(heartbeat_s=0.1, heartbeat_timeout_s=0.7)
+
+# the direction serve traffic should pull the embedding: a fixed,
+# deterministic per-id target row (the drill's eval metric is distance
+# to it)
+TARGET = np.random.RandomState(77).uniform(
+    -0.5, 0.5, (VOCAB, HID)).astype(np.float32)
+
+
+def _geo_specs(dim):
+    return {"wte": {"type": "geo_sparse", "dim": dim, "init": "zeros"}}
+
+
+def _cluster(n=3, k=1, dim=HID):
+    servers = [PSServer("127.0.0.1:0", _geo_specs(dim)) for _ in range(n)]
+    eps = [s.start() for s in servers]
+    smap = ShardMap.create(eps, n_backups=k)
+    for s in servers:
+        s.enable_replication(shard_map=smap, peers=eps, n_backups=k,
+                             rpc_opts=dict(FAST), **HB)
+    return servers, eps
+
+
+def _teardown(servers, *closers):
+    for c in closers:
+        try:
+            c.close()
+        except Exception:
+            pass
+    for s in servers:
+        s.shutdown()
+
+
+def _await_promotion(client, dead_ep, deadline=15.0):
+    """Poll until the client's shard map adopts the epoch without
+    `dead_ep` (heartbeat suspicion -> backup promotion)."""
+    t0 = time.perf_counter()
+    last = None
+    while time.perf_counter() - t0 < deadline:
+        try:
+            client.refresh_shard_map()
+        except Exception as e:  # a dead peer mid-refresh; keep polling
+            last = e
+        if dead_ep not in client.shard_map.servers:
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"no promotion after {dead_ep} died ({last!r})")
+
+
+def _delta(before, name):
+    return monitor.stat_get(name) - before.get(name, 0)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_injector():
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _dynamic_after():
+    yield
+    paddle.disable_static()
+
+
+@pytest.fixture(scope="module")
+def net():
+    paddle.seed(0)
+    m = GPT(GPTConfig.tiny())
+    m.eval()
+    yield m
+    # This module is the first heavy GPT/jit user in the suite's
+    # alphabetical order; drop its compiled graphs so the heartbeat-timed
+    # chaos drills in test_ps_sharded_embedding.py don't inherit the
+    # memory/GC pressure.
+    del m
+    import gc
+    import jax
+    jax.clear_caches()
+    gc.collect()
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: retire-time completion-record seam
+# ---------------------------------------------------------------------------
+
+def test_completion_record_seam(net):
+    recs = []
+    loop = ServeLoop(net, ServeConfig(max_active=2, kv_blocks=16,
+                                      block_size=16, max_seq_len=64),
+                     on_complete=recs.append)
+    prompts = [np.array([1, 2, 3], np.int64),
+               np.array([7, 8, 9, 10], np.int64)]
+    reqs = [loop.submit(p, max_new_tokens=4) for p in prompts]
+    loop.run_until_idle()
+
+    assert len(recs) == 2
+    for p, req in zip(prompts, reqs):
+        rec = next(r for r in recs if r["rid"] == req.rid)
+        assert rec["prompt"] == [int(t) for t in p]
+        assert rec["tokens"] == req.result(timeout=0).tolist()
+        assert rec["version"] == 0          # pinned at first admission
+        assert rec["preemptions"] == 0
+        assert rec["t_submit"] <= rec["t_first"] <= rec["t_done"]
+        assert rec["ttft_s"] > 0 and rec["per_token_s"] > 0
+        json.dumps(rec)   # host ints/floats only — queueable as-is
+
+
+def test_completion_hook_errors_are_contained(net):
+    def bad_hook(rec):
+        raise RuntimeError("log sink down")
+
+    loop = ServeLoop(net, ServeConfig(max_active=2, kv_blocks=16,
+                                      block_size=16, max_seq_len=64),
+                     on_complete=bad_hook)
+    before = monitor.stats("serve.")
+    outs = loop.serve([[1, 2], [3, 4, 5]], max_new_tokens=3)
+    # a broken completion sink must never fail serving
+    assert all(len(o) == 3 for o in outs)
+    assert _delta(before, "serve.completion_log_errors") == 2
+    assert _delta(before, "serve.requests_errored") == 0
+
+
+# ---------------------------------------------------------------------------
+# StreamingDataset: dedupe window, checkpoint cut, backpressure
+# ---------------------------------------------------------------------------
+
+def _rec(rid):
+    return {"rid": rid, "prompt": [rid], "tokens": [rid + 1]}
+
+
+def test_streaming_dedupe_and_checkpoint_cut():
+    ds = StreamingDataset(batch_size=4, name="s-cut")
+    for rid in range(10):
+        assert ds.offer(_rec(rid))        # accepted
+        assert not ds.offer(_rec(rid))    # at-least-once duplicate
+    st = ds.stats()
+    assert (st["accepted"], st["duplicates"], st["watermark"]) == (10, 10, 9)
+
+    gen = ds.batches()
+    got = [r["rid"] for r in next(gen)] + [r["rid"] for r in next(gen)]
+    assert got == list(range(8))
+
+    # checkpoint cut: buffer, window and cursor move to a fresh instance
+    snap = ds.state_dict()
+    ds2 = StreamingDataset(batch_size=4, name="s-cut2")
+    ds2.load_state_dict(snap)
+    with pytest.raises(ValueError):
+        next(ds2.batches(start_batch=0))  # out-of-sync resume is loud
+    assert not ds2.offer(_rec(3))         # window survives the cut
+    ds2.close()
+    tail = [[r["rid"] for r in b] for b in ds2.batches(start_batch=2)]
+    assert tail == [[8, 9]]               # final partial batch, no loss
+    assert ds2.stats()["delivered_records"] == 10
+
+
+def test_streaming_backpressure_bounds_the_queue():
+    ds = StreamingDataset(batch_size=1, capacity=2, name="s-cap")
+    assert ds.offer(_rec(0)) and ds.offer(_rec(1))
+    t0 = time.perf_counter()
+    assert not ds.offer(_rec(2), timeout=0.05)   # blocks, then rejects
+    assert time.perf_counter() - t0 >= 0.04
+    assert ds.stats()["rejected_full"] == 1
+    next(ds.batches())                            # free one slot
+    assert ds.offer(_rec(2), timeout=0.05)
+
+
+# satellite 2: scripted backlog burst — pause/resume, never drop
+def test_backlog_burst_pauses_without_drop():
+    ds = StreamingDataset(batch_size=1, name="s-burst")
+    for rid in range(6):
+        ds.offer(_rec(rid))
+    ds.close()
+    with faults.inject(faults.backlog_burst(name="s-burst", after=1,
+                                            times=2, delay=0.15)) as inj:
+        t0 = time.perf_counter()
+        got = [b[0]["rid"] for b in ds.batches()]
+        burst_s = time.perf_counter() - t0
+    assert got == list(range(6))          # every record, in order
+    assert inj.fired(faults.STALL) == 2
+    assert burst_s >= 0.3                 # delivery actually paused
+    assert ds.stats()["delivery_faults"] == 0
+
+    # chaos RESET at the deliver boundary is absorbed, not a drop
+    ds2 = StreamingDataset(batch_size=2, name="s-reset")
+    for rid in range(4):
+        ds2.offer(_rec(rid))
+    ds2.close()
+    with faults.inject(faults.Fault("stream", "deliver", faults.RESET,
+                                    method="s-reset", times=3)):
+        got = [[r["rid"] for r in b] for b in ds2.batches()]
+    assert got == [[0, 1], [2, 3]]
+    assert ds2.stats()["delivery_faults"] == 3
+
+
+# ---------------------------------------------------------------------------
+# zero-downtime hot-swap: drain barrier + version pinning
+# ---------------------------------------------------------------------------
+
+def test_hot_swap_drains_pins_and_redirects():
+    paddle.seed(0)
+    m = GPT(GPTConfig.tiny())
+    m.eval()
+    recs = []
+    loop = ServeLoop(m, ServeConfig(max_active=2, kv_blocks=24,
+                                    block_size=16, max_seq_len=64),
+                     on_complete=recs.append)
+    wte_key = next(k for k, v in loop._params.items()
+                   if tuple(v.shape) == (VOCAB, HID))
+    prompt = np.array([3, 1, 4, 1], np.int64)
+    before = monitor.stats("serve.")
+
+    r0 = loop.submit(prompt, max_new_tokens=8)
+    loop.run_until_idle()
+
+    with pytest.raises(KeyError):
+        loop.publish_weights(1, {"nope": np.zeros((2, 2))})
+    with pytest.raises(ValueError):
+        loop.publish_weights(1, {wte_key: np.zeros((3, 3))})
+
+    rolled = np.roll(np.asarray(loop._params[wte_key]), 7, axis=0)
+    loop.publish_weights(5, {wte_key: rolled})
+    assert loop.stats()["swap_staged"] and loop.model_version == 0
+    r1 = loop.submit(prompt, max_new_tokens=8)
+    loop.run_until_idle()
+    assert loop.model_version == 5 and not loop.stats()["swap_staged"]
+
+    rec0 = next(r for r in recs if r["rid"] == r0.rid)
+    rec1 = next(r for r in recs if r["rid"] == r1.rid)
+    assert (rec0["version"], rec1["version"]) == (0, 5)
+    # the swap is live: same prompt, different model, different stream
+    assert rec0["tokens"] != rec1["tokens"]
+
+    # started-loop mode: a stream in flight when the swap stages runs to
+    # retirement on its pinned version; the next admit gets the new one
+    loop.start()
+    try:
+        rA = loop.submit(prompt, max_new_tokens=40)
+        while rA.t_first is None:
+            time.sleep(0.005)
+        loop.publish_weights(6, {wte_key: np.asarray(rolled)[::-1].copy()})
+        rB = loop.submit(prompt, max_new_tokens=4)
+        assert len(rA.result(timeout=30)) == 40
+        assert len(rB.result(timeout=30)) == 4
+    finally:
+        loop.stop()
+    recA = next(r for r in recs if r["rid"] == rA.rid)
+    recB = next(r for r in recs if r["rid"] == rB.rid)
+    assert recA["version"] == 5           # pinned across the staged swap
+    assert recB["version"] == 6           # admitted only after it applied
+    assert _delta(before, "serve.hot_swaps") == 2
+    assert _delta(before, "serve.requests_errored") == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: push_sparse_delta dedupes server-side across failover
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_push_delta_dedupes_across_failover_reroute():
+    servers, eps = _cluster(3, 1, dim=4)
+    client = PSClient(eps, **FAST)
+    try:
+        ids = np.array([0], np.int64)          # shard 0: eps[0] -> eps[1]
+        one = np.ones((1, 4), np.float32)
+        applied = lambda j: servers[j].table("wte").applied  # noqa: E731
+
+        client.push_sparse_delta("wte", ids, one, request_key=("t", 0))
+        assert (applied(0), applied(1), applied(2)) == (1, 1, 0)
+
+        # lost ack: the reply frame drops AFTER primary applied+forwarded;
+        # the transport retry replays out of the rid cache on both members
+        with faults.inject(faults.Fault("server", "reply", faults.DROP,
+                                        method="push_sparse_delta")) as inj:
+            client.push_sparse_delta("wte", ids, one, request_key=("t", 1))
+        assert inj.fired(faults.DROP) == 1
+        assert (applied(0), applied(1), applied(2)) == (2, 2, 0)
+
+        # primary dies; the SAME unacked payload resent under the SAME
+        # key re-routes to the promoted backup, whose replay cache holds
+        # the rid from the forward — replayed, never re-applied
+        servers[0].shutdown()
+        _await_promotion(client, eps[0])
+        client.push_sparse_delta("wte", ids, one, request_key=("t", 1))
+        assert applied(1) == 2
+        assert np.allclose(client.pull_sparse("wte", ids), 2.0)
+
+        # fresh traffic still lands exactly once on the new primary
+        client.push_sparse_delta("wte", ids, one, request_key=("t", 2))
+        assert applied(1) == 3 and applied(2) == 0
+        assert np.allclose(client.pull_sparse("wte", ids), 3.0)
+    finally:
+        _teardown(servers[1:], client)
+
+
+# ---------------------------------------------------------------------------
+# continuous Downpour trainer: frozen-payload retry + staleness bound
+# ---------------------------------------------------------------------------
+
+T_VOCAB, T_DIM = 32, 4
+T_TARGET = np.random.RandomState(5).uniform(
+    -1.0, 1.0, (T_VOCAB, T_DIM)).astype(np.float32)
+
+
+def _build_online_program(vocab, dim, lr=0.25, name="online"):
+    from paddle_tpu import nn, optimizer
+    paddle.enable_static()
+    main = static.Program(name)
+    with static.program_guard(main):
+        ids = static.data("ids", [-1], "int64")
+        target = static.data("target", [-1, dim], "float32")
+        emb = nn.Embedding(vocab, dim)
+        rows = emb(ids)
+        diff = rows - target
+        # mean over tokens, sum over dim: per-occurrence row movement is
+        # 2*lr*n/N <= 2*lr — a contraction toward the target for lr<0.5
+        # no matter how duplicated an id is within the batch
+        loss = paddle.ops.mean(paddle.ops.sum(diff * diff, axis=-1))
+        opt = optimizer.SGD(learning_rate=lr)
+        opt.minimize(loss)
+    return main, loss, emb.weight.scope_name
+
+
+class _FeedDataset:
+    def __init__(self, feeds):
+        self._feeds = feeds
+
+    def batches(self, start_batch=0):
+        yield from self._feeds[start_batch:]
+
+
+def test_online_trainer_frozen_payload_retries_exactly_once():
+    srv = PSServer("127.0.0.1:0", _geo_specs(T_DIM))
+    ep = srv.start()
+    client = PSClient([ep], **FAST)
+    main, loss, emb_name = _build_online_program(T_VOCAB, T_DIM)
+    exe = static.Executor()
+    scope = static.global_scope()
+    uniq = np.array([0, 1, 2, 3], np.int64)
+    feeds = [{"ids": uniq, "target": T_TARGET[uniq]} for _ in range(4)]
+    holder = {}
+    before = monitor.stats("ps.online.")
+    try:
+        # the first flush's transport attempts ALL reset (1 try + 2
+        # retries): the payload freezes, defers inside the staleness
+        # bound, and resends NEXT batch under its original request key
+        with faults.inject(faults.Fault("client", "send", faults.RESET,
+                                        method="push_sparse_delta",
+                                        times=3)) as inj:
+            exe.train_from_dataset(
+                program=main, dataset=_FeedDataset(feeds),
+                ps_config={"client": client, "mode": "online",
+                           "sync_every": 1, "staleness_batches": 3,
+                           "sparse": [{"param": emb_name, "slot": "ids",
+                                       "table": "wte"}],
+                           "on_batch": lambda d: holder.update(drv=d)})
+        assert inj.fired(faults.RESET) == 3
+        drv = holder["drv"]
+        assert [seq for _, seq, _ in drv.flush_log] == [0, 1, 2, 3]
+        assert _delta(before, "ps.online.deferred_flushes") == 1
+        # every cut payload applied EXACTLY once despite the dead flush
+        assert srv.table("wte").applied == 4
+        # single-trainer invariant: server rows == local trained rows
+        local = np.asarray(scope.get(emb_name), np.float32)[uniq]
+        assert np.allclose(client.pull_sparse("wte", uniq), local,
+                           atol=1e-5)
+        # and the traffic moved the table toward the target
+        assert np.square(local - T_TARGET[uniq]).mean() \
+            < np.square(T_TARGET[uniq]).mean()
+    finally:
+        _teardown([srv], client)
+
+
+def test_online_trainer_staleness_bound_fails_stop():
+    srv = PSServer("127.0.0.1:0", _geo_specs(T_DIM))
+    ep = srv.start()
+    client = PSClient([ep], **FAST)
+    main, _, emb_name = _build_online_program(T_VOCAB, T_DIM,
+                                              name="online-stale")
+    exe = static.Executor()
+    uniq = np.array([4, 5], np.int64)
+    feeds = [{"ids": uniq, "target": T_TARGET[uniq]} for _ in range(4)]
+    try:
+        with faults.inject(faults.Fault("client", "send", faults.RESET,
+                                        method="push_sparse_delta",
+                                        times=10 ** 9)):
+            with pytest.raises((ConnectionError, OSError, RuntimeError)):
+                # flush 1 defers; flush 2 trips the bound and fail-stops
+                exe.train_from_dataset(
+                    program=main, dataset=_FeedDataset(feeds),
+                    ps_config={"client": client, "mode": "online",
+                               "sync_every": 1, "staleness_batches": 2,
+                               "sparse": [{"param": emb_name,
+                                           "slot": "ids",
+                                           "table": "wte"}]})
+    finally:
+        _teardown([srv], client)
+
+
+# ---------------------------------------------------------------------------
+# versioned snapshot publisher
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_snapshot_publisher_cursor_failover_and_cache():
+    servers, eps = _cluster(3, 1, dim=4)
+    client = PSClient(eps, **FAST)
+    cache = HeterPSCache(client, "wte", 4, capacity=8, host_rows=0)
+    try:
+        ids = np.arange(6, dtype=np.int64)
+        rows = np.tile(np.arange(1, 7, dtype=np.float32)[:, None], (1, 4))
+        client.push_sparse_delta("wte", ids, rows, request_key=("p", 0))
+        cache.pull(np.array([4], np.int64))       # warm the cache
+
+        pub = EmbeddingSnapshotPublisher(client, "wte", cache=cache)
+        before = monitor.stats("ps.")
+        v1, snap1 = pub.publish()
+        assert v1 == 1 and len(snap1) == 6
+        assert all(np.allclose(snap1[int(i)], rows[i]) for i in ids)
+
+        # untouched cluster: cursors unchanged, nothing refetched
+        v2, snap2 = pub.publish()
+        assert v2 == 2
+        assert all(np.allclose(snap2[int(i)], rows[i]) for i in ids)
+        assert _delta(before, "ps.publish.shards_refetched") == 3  # v1 only
+
+        # one id trains -> only the servers that saw the mutation
+        # refetch: shard 1's primary applied it and shard 2's primary
+        # holds the forwarded backup copy (the seq cursor is per-server,
+        # so backup traffic moves it too); shard 0 stays cached. The
+        # attached cache invalidates so the published row is SERVED.
+        client.push_sparse_delta("wte", np.array([4], np.int64),
+                                 np.ones((1, 4), np.float32),
+                                 request_key=("p", 1))
+        v3, snap3 = pub.publish()
+        assert np.allclose(snap3[4], rows[4] + 1.0)
+        assert _delta(before, "ps.publish.shards_refetched") == 5
+        assert _delta(before, "ps.heter.invalidations") >= 3
+        assert np.allclose(cache.pull(np.array([4], np.int64))[0],
+                           rows[4] + 1.0)
+
+        # a publish mid-failover rides the re-route to the promoted
+        # backup — consistent snapshot, no half-published version
+        servers[0].shutdown()
+        _await_promotion(client, eps[0])
+        client.push_sparse_delta("wte", np.array([0], np.int64),
+                                 np.ones((1, 4), np.float32),
+                                 request_key=("p", 2))
+        v4, snap4 = pub.publish()
+        assert v4 == 4 and np.allclose(snap4[0], rows[0] + 1.0)
+
+        # materialize overlays published rows on the served base
+        base = np.zeros((8, 4), np.float32)
+        dense = pub.materialize(base)
+        assert np.allclose(dense[0], rows[0] + 1.0)
+        assert np.allclose(dense[6:], 0.0)
+    finally:
+        _teardown(servers[1:], client)
+
+
+def test_snapshot_publisher_unreplicated_fallback():
+    srv = PSServer("127.0.0.1:0", _geo_specs(4))
+    ep = srv.start()
+    client = PSClient([ep], **FAST)
+    try:
+        ids = np.array([2, 9], np.int64)
+        client.push_sparse_delta("wte", ids,
+                                 np.full((2, 4), 3.0, np.float32),
+                                 request_key=("u", 0))
+        pub = EmbeddingSnapshotPublisher(client, "wte")
+        before = monitor.stats("ps.publish.")
+        _, snap = pub.publish()
+        assert np.allclose(snap[2], 3.0) and np.allclose(snap[9], 3.0)
+        pub.publish()
+        # no replication gate -> no cutoff cursor: every publish refetches
+        assert _delta(before, "ps.publish.shards_refetched") == 2
+    finally:
+        _teardown([srv], client)
+
+
+# ---------------------------------------------------------------------------
+# THE drill: the closed loop under chaos
+# ---------------------------------------------------------------------------
+
+class _Window:
+    """Expose the shared streaming generator to train_from_dataset a
+    fixed number of batches at a time — each call is one trainer
+    "session" over the same exactly-once stream."""
+
+    def __init__(self, ds):
+        self.ds = ds
+        self._gen = None
+        self.n = 0
+
+    def take(self, n):
+        self.n = int(n)
+        return self
+
+    def batches(self, start_batch=0):
+        if self._gen is None:
+            self._gen = self.ds.batches(start_batch=start_batch)
+        else:
+            assert int(start_batch) == \
+                self.ds.stats()["delivered_batches"]
+        return itertools.islice(self._gen, self.n)
+
+
+@pytest.mark.chaos
+def test_online_learning_chaos_drill():
+    servers, eps = _cluster(3, 1, dim=HID)
+    paddle.seed(0)
+    gpt = GPT(GPTConfig.tiny())
+    gpt.eval()
+
+    trained_ids = set()
+
+    def _collate(recs):
+        ids = np.concatenate([np.asarray(r["prompt"] + r["tokens"],
+                                         np.int64) for r in recs])
+        trained_ids.update(int(t) for t in ids)
+        return {"ids": ids, "target": TARGET[ids]}
+
+    ds = StreamingDataset(batch_size=3, collate=_collate, name="drill")
+
+    def _on_complete(rec):   # at-least-once transport: every record twice
+        ds.offer(rec)
+        ds.offer(rec)
+
+    loop = ServeLoop(gpt, ServeConfig(max_active=4, kv_blocks=16,
+                                      block_size=16, max_seq_len=64),
+                     on_complete=_on_complete)
+    wte_key = next(k for k, v in loop._params.items()
+                   if tuple(v.shape) == (VOCAB, HID))
+    wte0 = np.asarray(loop._params[wte_key]).copy()
+
+    main, loss, emb_name = _build_online_program(VOCAB, HID, lr=0.25,
+                                                 name="drill")
+    exe = static.Executor()
+    window = _Window(ds)
+    holder = {}
+    all_reqs = []
+    snaps = []
+
+    clients = [PSClient(eps, **FAST),      # trainer, first life
+               PSClient(eps, **FAST)]      # publisher + serving cache
+    client_t, client_p = clients
+    cache = HeterPSCache(client_p, "wte", HID, capacity=256, host_rows=0)
+    pub = EmbeddingSnapshotPublisher(client_p, "wte", cache=cache)
+    prefetchers = []
+
+    def serve_phase(k):
+        rng = np.random.RandomState(1000 + k)
+        reqs = [loop.submit(rng.randint(0, 48, 4).astype(np.int64),
+                            max_new_tokens=6) for _ in range(6)]
+        loop.run_until_idle()
+        all_reqs.extend(reqs)
+
+    def train_phase(client, n_batches, state):
+        pf = EmbeddingPrefetcher(client, table="wte")
+        prefetchers.append(pf)
+        cfg = {"client": client, "mode": "online", "sync_every": 1,
+               "trainer_id": 7,
+               "sparse": [{"param": emb_name, "slot": "ids",
+                           "table": "wte", "prefetcher": pf}],
+               "on_batch": lambda d: holder.update(drv=d)}
+        if state is not None:
+            cfg["state"] = state
+        start = ds.stats()["delivered_batches"]
+        exe.train_from_dataset(program=main,
+                               dataset=window.take(n_batches),
+                               ps_config=cfg, start_batch=start)
+        drv = holder["drv"]
+        assert all(f is None for f in drv._frozen)  # phase fully acked
+        return {"online": drv.online_state(), "ds": ds.state_dict()}
+
+    def publish_and_swap():
+        version, _ = pub.publish()
+        snap = pub.materialize(np.asarray(loop._params[wte_key]))
+        loop.publish_weights(version, {wte_key: snap})
+        loop.run_until_idle()               # applies between beats
+        assert loop.model_version == version
+        snaps.append(snap)
+
+    before = monitor.stats("serve.")
+    try:
+        with faults.inject(seed=11, p={faults.RESET: 0.02,
+                                       faults.DROP: 0.02}) as inj:
+            serve_phase(0)
+            ckpt = train_phase(client_t, 2, None)       # flush seq 0,1
+            publish_and_swap()                          # v1
+
+            serve_phase(1)
+            ckpt = train_phase(client_t, 1, ckpt["online"])  # seq 2
+            k_kill = len(holder["drv"].flush_log)
+
+            # trainer "dies" at the checkpoint; a shard primary dies for
+            # real. The restarted trainer is a FRESH process image: new
+            # PSClient whose replay identity comes from the checkpoint.
+            servers[0].shutdown()
+            client_t2 = PSClient(eps, **FAST)
+            clients.append(client_t2)
+            _await_promotion(client_t2, eps[0])
+            ckpt = train_phase(client_t2, 1, ckpt["online"])  # seq 3
+            publish_and_swap()                          # v2 (rides failover)
+
+            serve_phase(2)
+            train_phase(client_t2, 2, ckpt["online"])   # seq 4,5
+            publish_and_swap()                          # v3
+
+            # chaos actually ran
+            assert inj.fired(faults.RESET) >= 1
+            assert inj.fired(faults.DROP) >= 1
+
+        # ---- zero dropped serve requests across >=3 hot-swaps ----
+        assert len(all_reqs) == 18
+        assert all(len(r.result(timeout=0)) == 6 for r in all_reqs)
+        assert _delta(before, "serve.requests_completed") == 18
+        assert _delta(before, "serve.requests_errored") == 0
+        assert _delta(before, "serve.hot_swaps") == 3
+        assert loop.model_version == 3
+
+        # ---- exactly-once stream accounting ----
+        st = ds.stats()
+        assert st["accepted"] == 18 and st["duplicates"] == 18
+        assert st["delivered_records"] == 18
+        assert st["delivered_batches"] == 6 and st["backlog"] == 0
+
+        # ---- exactly-once delta accounting: replay the flush schedule
+        # against the membership timeline (shard s lives on eps[s] with
+        # backup eps[s+1]; the killed server leaves every chain) ----
+        log = holder["drv"].flush_log
+        assert [seq for _, seq, _ in log] == [0, 1, 2, 3, 4, 5]
+        expected = {ep: 0 for ep in eps}
+        for _, seq, ids in log:
+            for s in sorted({int(i) % 3 for i in ids}):
+                for ep in (eps[s], eps[(s + 1) % 3]):
+                    if seq >= k_kill and ep == eps[0]:
+                        continue
+                    expected[ep] += 1
+        for j in (1, 2):
+            assert servers[j].table("wte").applied == expected[eps[j]], \
+                f"server {j}: {servers[j].table('wte').applied} != " \
+                f"{expected[eps[j]]}"
+
+        # ---- the served model measurably shifted toward the traffic:
+        # versioned eval metric strictly decreases across snapshots ----
+        ev = np.fromiter(sorted(trained_ids), np.int64)
+        m = [float(np.square(w[ev] - TARGET[ev]).mean())
+             for w in [wte0] + snaps]
+        assert m[1] < m[0] and m[2] < m[1] and m[3] < m[2], m
+        assert m[3] < 0.9 * m[0], m
+        # the swap protocol also invalidated the serving-side cache
+        assert monitor.stat_get("ps.heter.invalidations") >= 3
+    finally:
+        _teardown(servers[1:], *clients, *prefetchers)

@@ -362,7 +362,7 @@ def check_concretization(ops_dir=OPS_DIR):
 TOOL_CROSS_CHECKS = ["spmd_lint", "spmd_plan", "hlo_evidence",
                      "pipeline_lint", "obs_report", "ps_load_test",
                      "elastic_drill", "serve_load_test",
-                     "pp_schedule_report"]
+                     "pp_schedule_report", "online_drill"]
 
 
 def check_registered_tools():
@@ -459,6 +459,43 @@ def check_perf_floors(evidence_path=EVIDENCE_PATH, floors=None):
 
 
 # ---------------------------------------------------------------------------
+# check 5: doc flag tables may not drift from core/flags.py
+# ---------------------------------------------------------------------------
+
+DOCS_DIR = os.path.join(REPO, "docs")
+
+# a markdown flag-table row: first cell is a backticked PADDLE_*/FLAGS_*
+# name (the convention every docs/*.md flag table follows)
+_DOC_FLAG_ROW = re.compile(r"^\| *`((?:PADDLE_|FLAGS_)[A-Za-z0-9_]+)`")
+
+
+def check_doc_flags(docs_dir=DOCS_DIR):
+    """Every flag a docs/*.md table documents must still exist in
+    core/flags.py — a renamed or deleted flag whose doc row survives is
+    operator-facing misinformation (the doc tells someone to set an env
+    var nothing reads). Returns a list of violation strings."""
+    problems = []
+    try:
+        from paddle_tpu.core import flags as _flags
+    except Exception as e:  # pragma: no cover
+        return [f"doc-flag check: paddle_tpu import failed: {e!r}"]
+    for fname in sorted(os.listdir(docs_dir)):
+        if not fname.endswith(".md"):
+            continue
+        path = os.path.join(docs_dir, fname)
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                m = _DOC_FLAG_ROW.match(line)
+                if m and m.group(1) not in _flags._DEFS:
+                    problems.append(
+                        f"docs/{fname}:{lineno} documents flag "
+                        f"{m.group(1)} which is not defined in "
+                        "core/flags.py — update the doc table or "
+                        "restore the flag")
+    return problems
+
+
+# ---------------------------------------------------------------------------
 
 def run_lint(spec_path=SPEC_PATH, versions_path=VERSIONS_PATH,
              ops_dir=OPS_DIR):
@@ -466,6 +503,7 @@ def run_lint(spec_path=SPEC_PATH, versions_path=VERSIONS_PATH,
     problems += check_concretization(ops_dir)
     problems += check_perf_floors()
     problems += check_registered_tools()
+    problems += check_doc_flags()
     return problems
 
 

@@ -182,11 +182,13 @@ def pallas_rates(metrics) -> str:
 # gauge/counter names the serving section renders; self_check pins them
 # against inference/serving.py GAUGES/COUNTERS so the two cannot drift
 SERVE_GAUGES = ("serve.queue_depth", "serve.active_slots",
-                "serve.kv_pool_used_blocks", "serve.kv_pool_free_blocks")
+                "serve.kv_pool_used_blocks", "serve.kv_pool_free_blocks",
+                "serve.model_version")
 SERVE_COUNTERS = ("serve.preempted", "serve.tokens_generated",
-                  "serve.requests_completed", "serve.requests_errored")
+                  "serve.requests_completed", "serve.requests_errored",
+                  "serve.hot_swaps", "serve.completion_log_errors")
 _SERVE_SPANS = ("serve/admit", "serve/prefill", "serve/decode_step",
-                "serve/retire", "serve/evict")
+                "serve/retire", "serve/evict", "serve/hot_swap")
 
 
 def serving_section(metrics, spans) -> str:
