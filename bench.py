@@ -1084,14 +1084,35 @@ def _emit_metrics_snapshot(mode):
     monitor snapshot (counters/gauges/histograms — executor pipeline
     gauges, pallas engagement, ps health), so BENCH_*.json carries the
     counters behind the perf numbers, not just the numbers
-    (tools/obs_report.py self_check pins this emission)."""
+    (tools/obs_report.py self_check pins this emission).
+
+    When PADDLE_TELEMETRY_HUB points at a running telemetry hub
+    (core/telemetry.py) and the mode has a fleet behind it
+    (serve/online/sparse), the line additionally carries the hub's
+    cluster-wide view under "hub" — fleet counters, merged histograms
+    and active SLOs next to the local process's numbers. Without the
+    env var the line is exactly the local snapshot (silent degrade)."""
     try:
         from paddle_tpu.core import monitor
         snap = monitor.snapshot(include_series=False)
-        print(json.dumps({"metric": f"{mode}_metrics_snapshot",
-                          "value": len(snap["values"]),
-                          "unit": "metrics", "monitor": snap},
-                         default=str), flush=True)
+        line = {"metric": f"{mode}_metrics_snapshot",
+                "value": len(snap["values"]),
+                "unit": "metrics", "monitor": snap}
+        hub_ep = os.environ.get("PADDLE_TELEMETRY_HUB", "")
+        if hub_ep and mode in ("serve", "online", "sparse"):
+            try:
+                from paddle_tpu.core import telemetry
+                hub = telemetry.fetch_snapshot(hub_ep)
+                line["hub"] = {
+                    "endpoint": hub_ep,
+                    "members": hub.get("members"),
+                    "counters": hub.get("counters"),
+                    "active_slos": hub.get("active_slos"),
+                    "span_count": hub.get("span_count"),
+                }
+            except Exception:
+                pass  # hub gone/unreachable: keep the local-only line
+        print(json.dumps(line, default=str), flush=True)
     except Exception as e:  # additive evidence; never block perf lines
         print(f"# metrics snapshot failed for {mode}: "
               f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)

@@ -388,3 +388,42 @@ define_flag("PADDLE_ONLINE_STALENESS_BATCHES", 4,
             "progress) is retried next cadence until this bound, then "
             "the flush error propagates (fail-stop) rather than letting "
             "the served model fall arbitrarily behind")
+
+# --- cluster telemetry plane (core/telemetry.py, core/slo.py,
+# --- tools/cluster_obs_drill.py) ---
+define_flag("PADDLE_TELEMETRY_HUB", "",
+            "host:port of a TelemetryHub. When set, processes that opt "
+            "in (drills, bench.py snapshot emitters, anything that "
+            "starts a TelemetryShipper) ship metric deltas / span "
+            "batches there; empty (the default) means fully local "
+            "observability, no network")
+define_flag("PADDLE_TELEMETRY_FLUSH_S", 0.5,
+            "TelemetryShipper flush cadence: every this many seconds "
+            "the background thread snapshots the monitor registry and "
+            "ships one replay-keyed delta batch to the hub. The hot "
+            "path only ever appends to an in-memory buffer — a slow or "
+            "dead hub can delay shipping, never a decode beat")
+define_flag("PADDLE_TELEMETRY_SPAN_BUFFER", 2048,
+            "bound on the shipper's finished-span buffer. When the hub "
+            "falls behind and the buffer is full, new spans are dropped "
+            "on the floor and counted in telemetry.dropped_spans / "
+            "telemetry.dropped_batches (backpressure by shedding, "
+            "never by blocking the thread that finished the span)")
+define_flag("PADDLE_TELEMETRY_INCIDENT_WINDOW_S", 10.0,
+            "incident coalescing window of the TelemetryHub: flight-"
+            "recorder triggers and SLO breaches arriving within this "
+            "many seconds of an open incident JOIN it (one incident id, "
+            "one merged dump) instead of opening a new one")
+define_flag("PADDLE_SLO_EVAL_S", 1.0,
+            "cadence of the hub's SLO engine: every this many seconds "
+            "the merged counters/histograms are appended to the burn-"
+            "rate series and every SLOSpec is re-evaluated")
+define_flag("PADDLE_SLO_FAST_WINDOW_S", 60.0,
+            "fast burn-rate window: a breach requires the bad fraction "
+            "over BOTH this window and the slow window to exceed the "
+            "objective — the fast window bounds time-to-detect, the "
+            "slow window filters blips")
+define_flag("PADDLE_SLO_SLOW_WINDOW_S", 300.0,
+            "slow burn-rate window (see PADDLE_SLO_FAST_WINDOW_S); "
+            "also bounds how much burn-rate history the engine retains "
+            "per SLO spec (2x this window)")

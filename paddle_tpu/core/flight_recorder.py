@@ -38,14 +38,21 @@ from . import trace as _trace
 
 __all__ = ["dump", "dump_dir", "enabled", "suppressed", "maybe_install",
            "install_signal_handlers", "register_emergency_hook",
-           "unregister_emergency_hook", "SCHEMA_VERSION", "SCHEMA_KEYS"]
+           "unregister_emergency_hook", "register_dump_listener",
+           "unregister_dump_listener", "set_identity",
+           "SCHEMA_VERSION", "SCHEMA_KEYS"]
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 # tools/obs_report.py renders exactly these sections; its self_check()
 # (registered in tools/framework_lint.py TOOL_CROSS_CHECKS) pins the two
 # against each other so the dump format and the renderer cannot drift.
+# v2 (cluster telemetry, core/telemetry.py) appended incident_id / role /
+# peer_members; every consumer reads them with .get() so v1 dumps on
+# disk keep rendering unchanged (regression-pinned in
+# tests/test_flight_recorder.py against a committed v1 fixture).
 SCHEMA_KEYS = ("schema", "reason", "time", "pid", "argv", "exception",
-               "spans", "metrics", "flags", "env", "extra")
+               "spans", "metrics", "flags", "env", "extra",
+               "incident_id", "role", "peer_members")
 
 _lock = threading.Lock()
 _dumped = defaultdict(int)
@@ -109,7 +116,24 @@ def _flags_snapshot():
         return {}
 
 
-def record(reason: str, exc=None, extra=None) -> dict:
+# Cluster identity (schema v2): a fleet member's role ("serve", "ps0",
+# "trainer", ...) and its known peers, stamped into every dump so a
+# merged incident can say WHO each record came from. Set once at member
+# startup (core/telemetry.py's TelemetryShipper does it for its owner).
+_role: str = ""
+_peer_members: list = []
+
+
+def set_identity(role=None, peers=None):
+    """Declare this process's fleet identity for future dumps."""
+    global _role, _peer_members
+    if role is not None:
+        _role = str(role)
+    if peers is not None:
+        _peer_members = [str(p) for p in peers]
+
+
+def record(reason: str, exc=None, extra=None, incident_id=None) -> dict:
     """The dump payload (also used by obs_report --live). Key set is
     SCHEMA_KEYS, schema version SCHEMA_VERSION."""
     return {
@@ -130,6 +154,9 @@ def record(reason: str, exc=None, extra=None) -> dict:
         "env": {k: v for k, v in os.environ.items()
                 if k.startswith(("PADDLE_", "FLAGS_", "JAX_"))},
         "extra": extra or {},
+        "incident_id": incident_id,
+        "role": _role,
+        "peer_members": list(_peer_members),
     }
 
 
@@ -174,13 +201,50 @@ def _fire_emergency_hooks(reason, exc):
             pass
 
 
-def dump(reason: str, exc=None, extra=None, _fire_hooks=True):
+# Dump listeners: fn(reason, exc, incident_id) fired for EVERY dump
+# trigger regardless of reason and of PADDLE_TPU_DUMP_DIR — the cluster
+# telemetry shipper uses this to report the trigger to the hub so the
+# whole fleet dumps under one incident id. Listeners get the incident_id
+# the dump was requested with (None for a locally-originated failure)
+# so a hub-requested incident dump does not re-report itself.
+_dump_listeners: list = []
+
+
+def register_dump_listener(fn):
+    with _lock:
+        if fn not in _dump_listeners:
+            _dump_listeners.append(fn)
+    return fn
+
+
+def unregister_dump_listener(fn):
+    with _lock:
+        try:
+            _dump_listeners.remove(fn)
+        except ValueError:
+            pass
+
+
+def _fire_dump_listeners(reason, exc, incident_id):
+    with _lock:
+        listeners = list(_dump_listeners)
+    for fn in listeners:
+        try:
+            fn(reason, exc, incident_id)
+        except Exception:
+            pass
+
+
+def dump(reason: str, exc=None, extra=None, incident_id=None,
+         _fire_hooks=True):
     """Write a flight-recorder dump; returns the path, or None when
     disabled/rate-limited. NEVER raises — a recorder failure must not
     mask the failure being recorded."""
     try:
         if _fire_hooks and not _is_suppressed(reason):
             _fire_emergency_hooks(reason, exc)
+        if not _is_suppressed(reason):
+            _fire_dump_listeners(reason, exc, incident_id)
         d = dump_dir()
         if not d or _is_suppressed(reason):
             return None
@@ -194,7 +258,8 @@ def dump(reason: str, exc=None, extra=None, _fire_hooks=True):
         os.makedirs(d, exist_ok=True)
         path = os.path.join(
             d, f"obsdump_{reason}_{os.getpid()}_{seq:03d}.json")
-        payload = record(reason, exc=exc, extra=extra)
+        payload = record(reason, exc=exc, extra=extra,
+                         incident_id=incident_id)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f, default=str)

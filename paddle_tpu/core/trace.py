@@ -61,6 +61,26 @@ _tls = threading.local()
 # core stays import-light (no upward dependency).
 _profiler_sink = None
 
+# Additional finished-span sinks (e.g. core/telemetry.py's shipper
+# buffering spans for the hub). Called OUTSIDE _lock on the thread that
+# finished the span — sinks must be non-blocking and never raise.
+_sinks: list = []
+
+
+def add_sink(fn):
+    """Register fn(span) to be called for every finished span."""
+    with _lock:
+        if fn not in _sinks:
+            _sinks.append(fn)
+
+
+def remove_sink(fn):
+    with _lock:
+        try:
+            _sinks.remove(fn)
+        except ValueError:
+            pass
+
 
 def _ring_size():
     try:
@@ -220,6 +240,11 @@ def _record(sp: Span):
     sink = _profiler_sink
     if sink is not None:
         sink(sp)
+    for fn in _sinks:
+        try:
+            fn(sp)
+        except Exception:
+            pass
 
 
 @contextlib.contextmanager

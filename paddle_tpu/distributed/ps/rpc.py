@@ -237,8 +237,14 @@ class Connection:
 
     def __init__(self, endpoint: str, timeout=None, connect_retry_s=None,
                  max_retries=None, backoff_base=None, backoff_max=None,
-                 fail_fast_refused=False):
+                 fail_fast_refused=False, quiet=False):
         self.endpoint = endpoint
+        # a quiet connection bumps no ps.rpc.* counters and records no
+        # spans: the telemetry shipper (core/telemetry.py) rides one so
+        # SHIPPING the observability stream never feeds back into it —
+        # the hub's counter totals must equal what the app did, not
+        # what the app did plus the act of reporting it
+        self._quiet = bool(quiet)
         # a refused connect normally retries within the connect window
         # (workers race the server's bind at job start); with a live
         # replicated shard map the client flips this on so a dead
@@ -345,21 +351,27 @@ class Connection:
         try:
             result = self._call_impl(sp, method, _mutating, _key, _rid,
                                      timeout, kwargs)
-            _monitor.observe("ps.rpc/latency_ms",
-                             (time.perf_counter() - t0) * 1e3)
+            if not self._quiet:
+                dt_ms = (time.perf_counter() - t0) * 1e3
+                _monitor.observe("ps.rpc/latency_ms", dt_ms)
+                # per-endpoint copy feeds the hub's shard-skew /
+                # straggler detector (core/slo.py latency_skew)
+                _monitor.observe(
+                    f"ps.rpc/endpoint_ms/{self.endpoint}", dt_ms)
             return result
         except BaseException as e:
             sp.attrs.setdefault("error", type(e).__name__)
-            _trace.end(sp)   # record BEFORE the dump snapshots the ring
+            _trace.end(sp, discard=self._quiet)
+            # record BEFORE the dump snapshots the ring
             extra = getattr(e, "_flight_extra", None)
-            if extra is not None:
+            if extra is not None and not self._quiet:
                 # retry budget exhausted: the transport is dead for this
                 # call — flight-record the span/metric history
                 from ...core import flight_recorder as _fr
                 _fr.dump("ps_transport_death", e, extra=extra)
             raise
         finally:
-            _trace.end(sp)
+            _trace.end(sp, discard=self._quiet)
 
     def _call_impl(self, sp, method, _mutating, _key, _rid, timeout, kwargs):
         req = {"method": method, **kwargs}
@@ -383,12 +395,14 @@ class Connection:
                     f"is {len(payload)} bytes "
                     f"(PADDLE_PS_MAX_FRAME={limit})")
             frame = _HDR.pack(len(payload)) + payload
-            _monitor.stat_add("ps.rpc.bytes_out", len(frame))
+            if not self._quiet:
+                _monitor.stat_add("ps.rpc.bytes_out", len(frame))
             attempts = self._max_retries + 1
             last_err = None
             for attempt in range(attempts):
                 if attempt:
-                    _monitor.stat_add("ps.rpc.retries")
+                    if not self._quiet:
+                        _monitor.stat_add("ps.rpc.retries")
                     delay = min(self._backoff_max,
                                 self._backoff_base * (2 ** (attempt - 1)))
                     # full jitter on [delay/2, delay] — decorrelates
@@ -397,7 +411,8 @@ class Connection:
                 try:
                     if self._sock is None:
                         self._dial(timeout)
-                        _monitor.stat_add("ps.rpc.reconnects")
+                        if not self._quiet:
+                            _monitor.stat_add("ps.rpc.reconnects")
                     self._sock.settimeout(timeout)
                     _fault("client", "send", method, self.endpoint)
                     self._sock.sendall(frame)
@@ -436,7 +451,8 @@ class Connection:
         # flight-recorder dump AFTER the span lands in the ring
         sp.attrs["attempts"] = attempts
         if isinstance(last_err, TimeoutError):
-            _monitor.stat_add("ps.rpc.deadline_exceeded")
+            if not self._quiet:
+                _monitor.stat_add("ps.rpc.deadline_exceeded")
             err = DeadlineExceeded(
                 f"ps rpc deadline exceeded calling {method!r} on "
                 f"{self.endpoint}: {attempts} attempts of {timeout:.1f}s "

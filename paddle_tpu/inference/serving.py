@@ -466,6 +466,16 @@ class ServeLoop:
         """One scheduler beat: settle enough of the pipeline to bound
         the window, admit, grow/preempt, dispatch the next fused decode
         step (N+1 overlapping the settle of step N)."""
+        # testing/faults.py ("serve", "beat") boundary: a scripted STALL
+        # here models a hung scheduler beat (the latency fault the SLO
+        # drill scripts a TTFT breach against). Transport-shaped chaos
+        # (RESET/DROP) has no meaning at a scheduler beat and is
+        # absorbed — the streaming deliver boundary does the same.
+        try:
+            from ..distributed.ps.rpc import _fault
+            _fault("serve", "beat", "tick")
+        except ConnectionError:
+            pass
         while len(self._pending) >= self._max_inflight:
             self._settle_one()
         if self._staged_swap is not None:

@@ -65,6 +65,14 @@ __all__ = ["PipelineRunner", "FetchHandle", "PipelineStepError",
 # takes 64-bit ids, so the wide layout costs nothing.
 _FLOW_NS = itertools.count(1)
 
+# Rolling-median straggler detector over the per-sync mean step time
+# (shared by every runner in the process — the counter it feeds,
+# executor.step_anomalies, is process-wide too). min_samples keeps JIT
+# warm-up syncs training the baseline instead of paging on it.
+from ..core.slo import RollingMedianDetector as _RollingMedianDetector  # noqa: E402
+
+_step_anomalies = _RollingMedianDetector(window=32, k=3.0, min_samples=8)
+
 
 class PipelineStepError(RuntimeError):
     """An in-flight step failed; raised at the materialization boundary
@@ -881,6 +889,11 @@ class PipelineRunner(_InflightWindow):
             _monitor.observe("executor/step_ms", wall_ms / steps)
             _monitor.observe("executor/host_ms",
                              self._host_s * 1000.0 / steps)
+            if _step_anomalies.observe(wall_ms / steps):
+                # straggler step: out of family vs the rolling median
+                # (core/slo.py) — counted so the telemetry hub's fleet
+                # view can attribute pod-scale step-time jitter
+                _monitor.stat_add("executor.step_anomalies")
         self._synced_through = self._next_index
         self._host_s = 0.0
         self._wall_t0 = time.perf_counter()

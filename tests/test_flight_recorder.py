@@ -104,6 +104,52 @@ def test_suppressed_scope_blocks_reason_on_this_thread(dump_dir):
     assert flight_recorder.dump("ps_transport_death") is not None
 
 
+def test_schema_v2_identity_fields(dump_dir):
+    # schema 2 adds cluster identity: incident_id + role + peer_members
+    flight_recorder.set_identity(role="server", peers=["a", "b"])
+    try:
+        p = flight_recorder.dump("unit_v2", incident_id="inc_test01")
+        rec = json.load(open(p))
+        assert rec["schema"] == 2 == flight_recorder.SCHEMA_VERSION
+        assert tuple(rec.keys()) == flight_recorder.SCHEMA_KEYS
+        assert rec["incident_id"] == "inc_test01"
+        assert rec["role"] == "server"
+        assert rec["peer_members"] == ["a", "b"]
+        text = obs_report.render(rec)
+        assert "role: server" in text
+        assert "incident: inc_test01" in text
+    finally:
+        flight_recorder.set_identity(role=None, peers=None)
+
+
+def test_v1_fixture_renders_unchanged():
+    # regression: committed schema-1 dumps must keep rendering
+    # byte-identically — v2 fields are additive and only printed when
+    # present, so old dumps never grow new lines
+    fix = os.path.join(os.path.dirname(__file__), "fixtures")
+    rec = obs_report.load(os.path.join(fix, "obsdump_v1.json"))
+    assert rec["schema"] == 1
+    want = open(os.path.join(fix, "obsdump_v1.expected.txt")).read()
+    assert obs_report.render(rec) + "\n" == want
+    assert "role:" not in want and "incident:" not in want
+
+
+def test_dump_listener_fires_once_per_trigger(dump_dir):
+    seen = []
+    flight_recorder.register_dump_listener(
+        lambda reason, exc, iid: seen.append((reason, iid)))
+    try:
+        flight_recorder.dump("listener_probe")
+        flight_recorder.dump("listener_probe2", incident_id="inc_x")
+    finally:
+        flight_recorder.unregister_dump_listener(
+            flight_recorder._dump_listeners[-1]
+            if flight_recorder._dump_listeners else None)
+        flight_recorder._dump_listeners.clear()
+    assert ("listener_probe", None) in seen
+    assert ("listener_probe2", "inc_x") in seen
+
+
 # ------------------------------------------ PipelineStepError -> dump
 
 def test_pipeline_step_error_dumps_and_report_renders(dump_dir):

@@ -1,0 +1,204 @@
+"""core/slo.py: the shared estimators, histogram merge, burn-rate
+engine, and anomaly detectors behind the cluster telemetry plane."""
+import numpy as np
+import pytest
+
+from paddle_tpu.core import slo
+from paddle_tpu.core.monitor import _Hist
+
+
+# --------------------------------------------------------------------------
+# percentile: the ONE estimator every tool's p50/p99 goes through
+# --------------------------------------------------------------------------
+
+def test_percentile_matches_numpy():
+    rng = np.random.RandomState(3)
+    xs = list(rng.uniform(0, 100, 257))
+    for p in (0, 25, 50, 90, 99, 100):
+        assert slo.percentile(xs, p) == pytest.approx(
+            float(np.percentile(np.asarray(xs), p)))
+
+
+def test_percentile_edge_cases():
+    assert slo.percentile([], 99) is None
+    assert slo.percentile([7.0], 50) == 7.0
+    assert slo.percentile([1, 2, 3, 4], 50, ndigits=3) == 2.5
+    # ndigits pins tool output bytes
+    assert slo.percentile([1.23456, 2.34567], 99, ndigits=3) == round(
+        float(np.percentile([1.23456, 2.34567], 99)), 3)
+
+
+# --------------------------------------------------------------------------
+# bucketed estimators
+# --------------------------------------------------------------------------
+
+def _hist(values, bounds=(1.0, 10.0, 100.0)):
+    h = _Hist(bounds)
+    for v in values:
+        h.observe(v)
+    return h.summary()
+
+
+def test_good_count_aligns_threshold_down():
+    s = _hist([0.5, 5.0, 50.0, 500.0])
+    # threshold exactly on a bound: everything in <=10 buckets is good
+    assert slo.good_count(s, 10.0) == (2, 4)
+    # threshold INSIDE the (10, 100] bucket aligns DOWN: the straddling
+    # bucket's observations count as bad (conservative, never optimistic)
+    assert slo.good_count(s, 60.0) == (2, 4)
+    assert slo.good_count(s, 100.0) == (3, 4)
+
+
+def test_good_count_without_buckets_uses_max():
+    assert slo.good_count({"count": 3, "max": 8.0}, 10.0) == (3, 3)
+    assert slo.good_count({"count": 3, "max": 80.0}, 10.0) == (0, 3)
+    assert slo.good_count({}, 10.0) == (0, 0)
+
+
+def test_hist_quantile_interpolates_and_clamps():
+    s = _hist([0.5] * 50 + [5.0] * 50)
+    q50 = slo.hist_quantile(s, 50)
+    assert 0.5 <= q50 <= 1.0
+    # p100 clamps to the exact max, not a bucket bound
+    assert slo.hist_quantile(s, 100) == 5.0
+    assert slo.hist_quantile({"count": 0}, 50) is None
+    # a degraded merge (no buckets) is honest: no quantiles
+    assert slo.hist_quantile({"count": 5, "sum": 1.0, "min": 0.1,
+                              "max": 0.5, "bounds": None,
+                              "buckets": None}, 50) is None
+
+
+# --------------------------------------------------------------------------
+# merge: per-process histograms fold into the union stream's histogram
+# --------------------------------------------------------------------------
+
+def test_merge_hists_equals_union_stream():
+    rng = np.random.RandomState(7)
+    a = list(rng.uniform(0, 120, 100))
+    b = list(rng.uniform(0, 120, 57))
+    merged = slo.merge_hists([_hist(a), _hist(b)])
+    union = _hist(a + b)
+    assert merged["buckets"] == union["buckets"]
+    assert merged["bounds"] == union["bounds"]
+    assert merged["count"] == union["count"] == 157
+    assert merged["sum"] == pytest.approx(union["sum"])
+    assert merged["min"] == union["min"]
+    assert merged["max"] == union["max"]
+
+
+def test_merge_hists_mixed_bounds_degrades_honestly():
+    a = _hist([1.0, 20.0], bounds=(1.0, 10.0, 100.0))
+    b = _hist([2.0, 30.0], bounds=(5.0, 50.0))
+    m = slo.merge_hists([a, b])
+    assert m["bounds"] is None and m["buckets"] is None
+    assert m["count"] == 4
+    assert m["min"] == 1.0 and m["max"] == 30.0
+    assert m["avg"] == pytest.approx((1 + 20 + 2 + 30) / 4)
+    # empty input
+    z = slo.merge_hists([])
+    assert z["count"] == 0 and z["bounds"] is None
+
+
+# --------------------------------------------------------------------------
+# burn-rate engine
+# --------------------------------------------------------------------------
+
+def _lat_summary(good, bad, threshold=100.0):
+    return {"count": good + bad, "sum": 0.0, "min": 0.0, "max": 1.0,
+            "bounds": [threshold], "buckets": [good, bad]}
+
+
+def test_latency_slo_breach_and_hysteretic_clear():
+    spec = slo.SLOSpec("lat", "latency", "m", objective=0.05,
+                       threshold_ms=100.0)
+    eng = slo.SLOEngine([spec], fast_s=10.0, slow_s=60.0)
+    t0 = 1000.0
+    assert eng.observe({}, {"m": _lat_summary(0, 0)}, now=t0) == []
+    # sustained 50% bad vs a 5% objective: burn 10x in every window
+    alerts = eng.observe({}, {"m": _lat_summary(50, 50)}, now=t0 + 5)
+    assert [a["slo"] for a in alerts] == ["lat"]
+    assert alerts[0]["type"] == "slo_breach"
+    assert alerts[0]["burn"]["fast"] >= 1.0
+    assert alerts[0]["burn"]["slow"] >= 1.0
+    assert eng.active() == ["lat"]
+    # still burning: active, but NOT a duplicate alert
+    assert eng.observe({}, {"m": _lat_summary(50, 60)}, now=t0 + 6) == []
+    assert eng.active() == ["lat"]
+    # recovery: a flood of good observations drops the fast burn under
+    # threshold -> hysteretic clear
+    assert eng.observe({}, {"m": _lat_summary(2000, 60)},
+                       now=t0 + 20) == []
+    assert eng.active() == []
+
+
+def test_single_spike_cannot_page():
+    # one bad request in a sea of good traffic never crosses a 5% budget
+    spec = slo.SLOSpec("lat", "latency", "m", objective=0.05,
+                       threshold_ms=100.0)
+    eng = slo.SLOEngine([spec], fast_s=10.0, slow_s=60.0)
+    eng.observe({}, {"m": _lat_summary(0, 0)}, now=0.0)
+    assert eng.observe({}, {"m": _lat_summary(99, 1)}, now=5.0) == []
+    assert eng.active() == []
+
+
+def test_rate_slo_per_second_budget():
+    spec = slo.SLOSpec("errs", "rate", "err_count", objective=2.0)
+    eng = slo.SLOEngine([spec], fast_s=10.0, slow_s=60.0)
+    eng.observe({"err_count": 0.0}, {}, now=0.0)
+    # 100 errors in 10s = 10/s against a 2/s budget: burn 5x
+    alerts = eng.observe({"err_count": 100.0}, {}, now=10.0)
+    assert [a["slo"] for a in alerts] == ["errs"]
+    # quiet period: clears
+    eng.observe({"err_count": 100.0}, {}, now=25.0)
+    assert eng.active() == []
+
+
+def test_rate_slo_with_denominator():
+    spec = slo.SLOSpec("bad_frac", "rate", "bad", objective=0.01,
+                       denominator="total")
+    eng = slo.SLOEngine([spec], fast_s=10.0, slow_s=60.0)
+    eng.observe({"bad": 0.0, "total": 0.0}, {}, now=0.0)
+    alerts = eng.observe({"bad": 5.0, "total": 100.0}, {}, now=5.0)
+    assert [a["slo"] for a in alerts] == ["bad_frac"]    # 5% vs 1%
+    # no new bad events -> no breach even while the ratio history stands
+    eng2 = slo.SLOEngine([spec], fast_s=10.0, slow_s=60.0)
+    eng2.observe({"bad": 0.0, "total": 0.0}, {}, now=0.0)
+    assert eng2.observe({"bad": 0.0, "total": 100.0}, {}, now=5.0) == []
+
+
+def test_slospec_validation():
+    with pytest.raises(ValueError):
+        slo.SLOSpec("x", "latency", "m", objective=0.1)  # no threshold
+    with pytest.raises(ValueError):
+        slo.SLOSpec("x", "weird", "m", objective=0.1)
+    d = slo.SLOSpec("x", "rate", "m", objective=0.1).to_dict()
+    assert d["name"] == "x" and d["kind"] == "rate"
+
+
+# --------------------------------------------------------------------------
+# anomaly detectors
+# --------------------------------------------------------------------------
+
+def test_rolling_median_detector_warmup_spike_and_level_change():
+    det = slo.RollingMedianDetector(window=16, k=3.0, min_samples=8)
+    # warm-up: even huge values train the baseline without paging
+    for v in (50.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0):
+        assert det.observe(v) is False
+    assert det.anomalies == 0
+    # in-family observation
+    assert det.observe(1.1) is False
+    # a straggler 10x the median pages
+    assert det.observe(10.0) is True
+    assert det.anomalies == 1
+    # a sustained shift stops being anomalous once the median catches up
+    flags = [det.observe(10.0) for _ in range(20)]
+    assert flags[-1] is False
+    assert det.median() == pytest.approx(10.0)
+
+
+def test_latency_skew():
+    skew, worst = slo.latency_skew({"s0": 1.0, "s1": 1.0, "s2": 3.0})
+    assert worst == "s2" and skew == pytest.approx(3.0)
+    assert slo.latency_skew({"s0": 2.0}) is None
+    assert slo.latency_skew({"s0": None, "s1": 2.0}) is None
+    assert slo.latency_skew({"s0": 0.0, "s1": 0.0}) is None

@@ -1,0 +1,246 @@
+"""core/telemetry.py in-process: exactly-once counter shipping through
+scripted transport chaos, last-wins gauges, union-exact histogram
+merge, never-block-the-hot-path span backpressure, and the coordinated
+incident protocol (trigger -> join -> merged dump)."""
+import copy
+import json
+import os
+import time
+
+import pytest
+
+from paddle_tpu.core import flight_recorder, monitor, telemetry, trace
+from paddle_tpu.core.monitor import _Hist
+from paddle_tpu.testing import faults
+
+FAST_RPC = dict(timeout=0.5, max_retries=3, backoff_base=0.01,
+                backoff_max=0.05, connect_retry_s=1.0)
+
+
+class _Registry:
+    """A fake per-process monitor registry the shipper snapshots."""
+
+    def __init__(self):
+        self.values = {}
+        self.types = {}
+        self.hists = {}
+
+    def counter(self, name, v):
+        self.values[name] = self.values.get(name, 0.0) + v
+        self.types[name] = "counter"
+
+    def gauge(self, name, v):
+        self.values[name] = v
+        self.types[name] = "gauge"
+
+    def hist(self, name, summary):
+        self.hists[name] = summary
+        self.types[name] = "histogram"
+
+    def snapshot(self):
+        return copy.deepcopy({"values": self.values, "types": self.types,
+                              "histograms": self.hists})
+
+
+@pytest.fixture
+def hub(tmp_path):
+    h = telemetry.TelemetryHub(dump_dir=str(tmp_path),
+                               incident_window_s=10.0)
+    yield h
+    h.stop()
+
+
+def _shipper(hub, member, reg, **kw):
+    kw.setdefault("rpc_opts", FAST_RPC)
+    kw.setdefault("capture_spans", False)
+    kw.setdefault("report_incidents", False)
+    return telemetry.TelemetryShipper(
+        hub.endpoint, member_id=member, snapshot_fn=reg.snapshot, **kw)
+
+
+def test_counters_exactly_once_through_drop_and_reset(hub):
+    reg = _Registry()
+    s = _shipper(hub, "m1", reg, role="worker")
+    try:
+        reg.counter("c", 5.0)
+        # the applied-but-reply-lost case replay keys exist for: the hub
+        # applies the delta, the reply is DROPPED, the retried shipment
+        # must be a replay (NOT a re-add)
+        with faults.inject(faults.Fault("server", "reply", faults.DROP,
+                                        method="telemetry_ship",
+                                        times=1)) as inj:
+            s.flush()
+            assert inj.fired(faults.DROP) == 1
+        assert hub.member_counters("m1") == {"c": 5.0}
+        # connection torn down mid-exchange: the reconnect retry carries
+        # the same replay key
+        reg.counter("c", 4.0)
+        with faults.inject(faults.Fault("server", "reply", faults.RESET,
+                                        method="telemetry_ship",
+                                        times=1)) as inj:
+            s.flush()
+            assert inj.fired(faults.RESET) == 1
+        assert hub.member_counters("m1") == {"c": 9.0}
+        assert hub.snapshot()["counters"] == {"c": 9.0}
+        # nothing new: a flush ships nothing and totals stand
+        s.flush()
+        assert hub.snapshot()["counters"] == {"c": 9.0}
+        assert s.shipped_totals()["c"] == 9.0
+    finally:
+        s.close(drain_timeout=2.0)
+
+
+def test_gauges_last_wins_and_multi_member_counter_sum(hub):
+    ra, rb = _Registry(), _Registry()
+    sa = _shipper(hub, "a", ra)
+    sb = _shipper(hub, "b", rb)
+    try:
+        ra.gauge("depth", 3.0)
+        ra.counter("n", 2.0)
+        sa.flush()
+        ra.gauge("depth", 7.0)
+        ra.counter("n", 1.0)
+        sa.flush()
+        rb.counter("n", 10.0)
+        sb.flush()
+        snap = hub.snapshot()
+        assert snap["gauges"]["depth"] == 7.0         # last wins
+        assert snap["counters"]["n"] == 13.0          # sum of members
+        assert hub.member_counters("a") == {"n": 3.0}
+        assert hub.member_counters("b") == {"n": 10.0}
+    finally:
+        sa.close(drain_timeout=2.0)
+        sb.close(drain_timeout=2.0)
+
+
+def test_hist_merge_across_members_equals_union_stream(hub):
+    import numpy as np
+    rng = np.random.RandomState(5)
+    xs_a = list(rng.uniform(0, 50, 80))
+    xs_b = list(rng.uniform(0, 50, 33))
+    bounds = (1.0, 5.0, 25.0)
+
+    def _summary(xs):
+        h = _Hist(bounds)
+        for v in xs:
+            h.observe(v)
+        return h.summary()
+
+    ra, rb = _Registry(), _Registry()
+    ra.hist("lat_ms", _summary(xs_a))
+    rb.hist("lat_ms", _summary(xs_b))
+    sa = _shipper(hub, "a", ra)
+    sb = _shipper(hub, "b", rb)
+    try:
+        sa.flush()
+        sb.flush()
+        merged = hub.snapshot()["hists"]["lat_ms"]
+        union = _summary(xs_a + xs_b)
+        assert merged["buckets"] == union["buckets"]
+        assert merged["bounds"] == union["bounds"]
+        assert merged["count"] == union["count"]
+        assert merged["sum"] == pytest.approx(union["sum"])
+    finally:
+        sa.close(drain_timeout=2.0)
+        sb.close(drain_timeout=2.0)
+
+
+def test_span_backpressure_never_blocks_and_counts_drops():
+    # a DEAD hub: nothing listens on the endpoint. The span sink (the
+    # hot-path side) must stay O(1) append/shed; the flush side fails
+    # without the sink ever waiting on it.
+    reg = _Registry()
+    before = monitor.stats("telemetry.")
+    s = telemetry.TelemetryShipper(
+        "127.0.0.1:9", member_id="dead", snapshot_fn=reg.snapshot,
+        span_buffer=8, rpc_opts=dict(timeout=0.2, max_retries=0,
+                                     backoff_base=0.01, backoff_max=0.02,
+                                     connect_retry_s=0.2,
+                                     fail_fast_refused=True),
+        report_incidents=False)
+    try:
+        t0 = time.perf_counter()
+        for i in range(500):
+            with trace.span("unit/backpressure", i=i):
+                pass
+        sink_wall = time.perf_counter() - t0
+        # 500 spans through a full buffer against a dead hub: the beat
+        # thread never blocked on telemetry
+        assert sink_wall < 1.0
+        reg.counter("c", 1.0)
+        # the flush side reports unreachable (the lazy dial fails) —
+        # never raises out of a member's beat thread
+        assert s.flush() is False
+        after = monitor.stats("telemetry.")
+        dropped = (after.get("telemetry.dropped_spans", 0)
+                   - before.get("telemetry.dropped_spans", 0))
+        batches = (after.get("telemetry.dropped_batches", 0)
+                   - before.get("telemetry.dropped_batches", 0))
+        assert dropped >= 490          # cap 8, the rest shed
+        assert batches >= 1            # the affected flush is counted
+    finally:
+        try:
+            s.close(drain_timeout=0.5)
+        except Exception:
+            pass                       # the hub is dead by design
+
+
+def test_incident_trigger_joins_and_merges(hub, tmp_path, monkeypatch):
+    monkeypatch.setattr(flight_recorder, "dump_dir", lambda: None)
+    reg = _Registry()
+    s = telemetry.TelemetryShipper(
+        hub.endpoint, member_id="w1", role="trainer", peers=["w1"],
+        snapshot_fn=reg.snapshot, flush_s=0.05, rpc_opts=FAST_RPC,
+        capture_spans=True, report_incidents=True).start()
+    try:
+        with trace.span("unit/incident_span"):
+            pass
+        flight_recorder.dump("unit_incident_trigger")
+        deadline = time.time() + 10.0
+        while time.time() < deadline and not hub.incidents():
+            time.sleep(0.05)
+        incs = hub.incidents()
+        assert len(incs) == 1
+        iid = next(iter(incs))
+        assert incs[iid]["reason"] == "unit_incident_trigger"
+        # a second trigger inside the window JOINS instead of opening
+        flight_recorder.dump("unit_incident_second")
+        time.sleep(0.3)
+        assert len(hub.incidents()) == 1
+        # the member's schema-v2 record lands in the merged dump
+        path = os.path.join(str(tmp_path), f"incident_{iid}.json")
+        deadline = time.time() + 10.0
+        rec = None
+        while time.time() < deadline:
+            with open(path) as f:
+                inc = json.load(f)
+            rec = inc["members"].get("w1")
+            if rec:
+                break
+            time.sleep(0.05)
+        assert rec, f"member record never attached: {inc['members']}"
+        assert inc["schema"] == telemetry.INCIDENT_SCHEMA
+        assert rec["schema"] == flight_recorder.SCHEMA_VERSION
+        assert rec["incident_id"] == iid
+        assert rec["role"] == "trainer"
+        assert any(sp["name"] == "unit/incident_span"
+                   for sp in rec["spans"])
+        assert "w1" in incs[iid]["triggers"]
+    finally:
+        s.close(drain_timeout=2.0)
+        flight_recorder.set_identity(role="", peers=[])
+
+
+def test_fetch_snapshot(hub):
+    reg = _Registry()
+    reg.counter("k", 3.0)
+    s = _shipper(hub, "f1", reg)
+    try:
+        s.flush()
+        snap = telemetry.fetch_snapshot(hub.endpoint)
+        assert snap["counters"] == {"k": 3.0}
+        assert "f1" in snap["members"]
+    finally:
+        s.close(drain_timeout=2.0)
+    with pytest.raises(Exception):
+        telemetry.fetch_snapshot("127.0.0.1:9", timeout=0.3)

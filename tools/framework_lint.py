@@ -362,7 +362,8 @@ def check_concretization(ops_dir=OPS_DIR):
 TOOL_CROSS_CHECKS = ["spmd_lint", "spmd_plan", "hlo_evidence",
                      "pipeline_lint", "obs_report", "ps_load_test",
                      "elastic_drill", "serve_load_test",
-                     "pp_schedule_report", "online_drill"]
+                     "pp_schedule_report", "online_drill",
+                     "cluster_obs_drill"]
 
 
 def check_registered_tools():

@@ -19,6 +19,13 @@ Usage:
   python tools/obs_report.py DUMP.json --trace out.json
                                 # also convert the dump's spans to a
                                 # Chrome trace (chrome://tracing)
+  python tools/obs_report.py --incident incident_<id>.json
+                                # render a MERGED incident dump from the
+                                # telemetry hub: alert + member tables,
+                                # stitched cross-process trace chains,
+                                # then each member's full report
+                                # (--trace writes the merged cluster
+                                # timeline with per-process lanes)
 
 `self_check()` is registered in tools/framework_lint.py TOOL_CROSS_CHECKS
 so tier-1 pins the three encodings of the observability config against
@@ -40,12 +47,20 @@ if REPO not in sys.path:
 
 # canonical observability config: the flag DEFAULTS (core/flags.py) must
 # match, and the dump schema version must match the recorder's
-OBS_CFG = {"ring": 4096, "series": 256, "schema": 1}
+OBS_CFG = {"ring": 4096, "series": 256, "schema": 2}
 
 # dump keys this renderer reads; self_check pins them against
-# flight_recorder.SCHEMA_KEYS so the two cannot drift
+# flight_recorder.SCHEMA_KEYS so the two cannot drift.  Schema v2 adds
+# the cluster-identity fields (incident_id/role/peer_members); render()
+# only prints them when present, so committed v1 dumps render unchanged
+# (tests/fixtures/obsdump_v1.json pins that).
 EXPECTED_KEYS = ("schema", "reason", "time", "pid", "argv", "exception",
-                 "spans", "metrics", "flags", "env", "extra")
+                 "spans", "metrics", "flags", "env", "extra",
+                 "incident_id", "role", "peer_members")
+
+# merged-incident files (telemetry hub) the --incident mode reads;
+# pinned against core.telemetry.INCIDENT_SCHEMA in self_check
+INCIDENT_SCHEMA = 1
 
 _STEP_SPANS = ("pipeline/dispatch", "pipeline/dispatch_scan",
                "pipeline/retire", "pipeline/materialize")
@@ -224,6 +239,15 @@ def render(dump: dict) -> str:
     out.append("== flight-recorder dump "
                f"(schema {dump.get('schema')}) ==")
     out.append(f"  reason: {dump.get('reason')}  pid: {dump.get('pid')}")
+    # schema-2 cluster identity: only printed when present, so v1 dumps
+    # (and solo v2 dumps) render byte-identically to before
+    if dump.get("role"):
+        peers = dump.get("peer_members") or []
+        out.append(f"  role: {dump['role']}"
+                   + (f"  peers: {', '.join(str(p) for p in peers)}"
+                      if peers else ""))
+    if dump.get("incident_id"):
+        out.append(f"  incident: {dump['incident_id']}")
     if exc:
         out.append(f"  exception: {exc.get('type')}: {exc.get('message')}")
     extra = dump.get("extra") or {}
@@ -242,6 +266,67 @@ def render(dump: dict) -> str:
     out.append("\n== serving ==")
     out.append(serving_section(metrics, spans))
     return "\n".join(out)
+
+
+def render_incident(inc: dict) -> str:
+    """A merged incident dump from the telemetry hub: the cluster-level
+    story first (alerts, members, stitched cross-process trace chains),
+    then every member's full per-process report."""
+    from paddle_tpu.core.telemetry import stitch_incident
+    out = []
+    out.append(f"== incident {inc.get('incident_id')} "
+               f"(schema {inc.get('schema')}) ==")
+    out.append(f"  reason: {inc.get('reason')}  time: {inc.get('time')}")
+    trig = inc.get("triggers") or []
+    if trig:
+        out.append("  triggers: "
+                   + "; ".join(json.dumps(t, default=str, sort_keys=True)
+                               for t in trig))
+    alerts = inc.get("alerts") or []
+    out.append(f"\n== slo alerts ({len(alerts)}) ==")
+    out.append(_fmt_table(
+        ["slo", "metric", "burn_fast", "burn_slow", "bad/total"],
+        [[a.get("slo"), a.get("metric"),
+          f"{(a.get('burn') or {}).get('fast', 0.0):.2f}",
+          f"{(a.get('burn') or {}).get('slow', 0.0):.2f}",
+          f"{a.get('bad')}/{a.get('total')}"] for a in alerts]))
+    members = inc.get("members") or {}
+    out.append(f"\n== members ({len(members)}) ==")
+    out.append(_fmt_table(
+        ["member", "role", "pid", "reason", "spans"],
+        [[m, (r or {}).get("role", ""), (r or {}).get("pid"),
+          (r or {}).get("reason"), len((r or {}).get("spans") or ())]
+         for m, r in sorted(members.items())]))
+    chains = stitch_incident(inc)
+    out.append(f"\n== cross-process trace chains ({len(chains)}) ==")
+    rows = []
+    for c in chains:
+        hops = " -> ".join(f"{r or m}({p})" for m, r, p in
+                           zip(c["members"], c["roles"], c["pids"]))
+        rows.append([c["trace_id"], hops, c["spans"],
+                     " ".join(c["span_names"][:6])])
+    out.append(_fmt_table(["trace", "path", "spans", "span_names"], rows))
+    for m, record in sorted(members.items()):
+        out.append(f"\n{'=' * 12} member {m} {'=' * 12}")
+        out.append(render(record or {}))
+    return "\n".join(out)
+
+
+def incident_to_chrome_trace(inc: dict, path: str):
+    """Merged cluster timeline: one Chrome-trace lane per member process,
+    so a client->primary->backup incident reads as one picture."""
+    from paddle_tpu.core import trace as _trace
+    events = []
+    for m, record in sorted((inc.get("members") or {}).items()):
+        pid = (record or {}).get("pid", 0)
+        role = (record or {}).get("role", "")
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"{role or 'member'} {m}"}})
+        events.extend(_trace.to_chrome_events(
+            (record or {}).get("spans") or [], pid=pid))
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return path
 
 
 def dump_to_chrome_trace(dump: dict, path: str):
@@ -276,6 +361,18 @@ def self_check():
         problems.append(
             f"obs_report: dump schema v{flight_recorder.SCHEMA_VERSION} "
             f"!= renderer v{OBS_CFG['schema']}")
+    # merged-incident files (--incident) <-> the hub's writer
+    try:
+        from paddle_tpu.core import telemetry as _telemetry
+        if _telemetry.INCIDENT_SCHEMA != INCIDENT_SCHEMA:
+            problems.append(
+                f"obs_report: telemetry.INCIDENT_SCHEMA "
+                f"{_telemetry.INCIDENT_SCHEMA} != renderer "
+                f"{INCIDENT_SCHEMA} — update both together")
+    except Exception as e:
+        problems.append(
+            f"obs_report: cannot cross-check telemetry incident "
+            f"schema: {e!r}")
     # flag DECLARED defaults (not live values — a test may have set them)
     defs = _flags._DEFS
     for name, want in (("FLAGS_trace_ring_size", OBS_CFG["ring"]),
@@ -330,6 +427,14 @@ def main(argv=None):
         i = argv.index("--trace")
         trace_out = argv[i + 1]
         del argv[i:i + 2]
+    if "--incident" in argv:
+        i = argv.index("--incident")
+        inc = load(argv[i + 1])
+        print(render_incident(inc))
+        if trace_out:
+            incident_to_chrome_trace(inc, trace_out)
+            print(f"\nchrome trace written to {trace_out}")
+        return 0
     if "--live" in argv:
         dump = live_record()
     elif argv:

@@ -322,11 +322,19 @@ def run():
         violations.append(f"eval metric not strictly decreasing across "
                           f"snapshot versions: {metric}")
 
+    # serving-tier latency through the SHARED estimator (core/slo.py) —
+    # comparable with serve_load_test's p50/p99 because the
+    # implementation is the same
+    from paddle_tpu.core.slo import percentile
+    ttfts = [r.ttft_s * 1e3 for r in all_reqs
+             if getattr(r, "ttft_s", None) is not None]
     report = {
         "tool": "tools/online_drill.py",
         "rounds": ROUNDS,
         "requests": want_reqs,
         "completed": done,
+        "ttft_ms": {"p50": percentile(ttfts, 50, ndigits=3),
+                    "p99": percentile(ttfts, 99, ndigits=3)},
         "hot_swaps": swaps,
         "model_version": loop.model_version,
         "chaos_fired": chaos_fired,
@@ -405,6 +413,12 @@ def self_check():
             problems.append(
                 f"online_drill: docs/online_learning.md no longer "
                 f"mentions `{token}`")
+    # ttft percentiles must come from the shared core/slo.py estimator
+    with open(os.path.abspath(__file__)) as f:
+        self_src = f.read()
+    if "from paddle_tpu.core.slo import percentile" not in self_src:
+        problems.append("online_drill: report ttft percentiles must "
+                        "come from core.slo.percentile")
     return problems
 
 

@@ -85,16 +85,19 @@ def run_worker(endpoints, wid, results):
     client = PSClient(endpoints)
     rng = np.random.RandomState(wid)
     pulled = pushed = 0
+    round_ms = []
     t0 = time.perf_counter()
     for _ in range(ROUNDS):
+        tr = time.perf_counter()
         ids = np.unique(rng.randint(0, VOCAB, BATCH_IDS).astype(np.int64))
         rows = client.pull_sparse("emb", ids)
         pulled += len(ids)
         grads = np.asarray(rows, np.float32) * 0 + 0.01
         client.push_sparse_grad("emb", ids, grads)
         pushed += len(ids)
+        round_ms.append((time.perf_counter() - tr) * 1e3)
     dt = time.perf_counter() - t0
-    results[wid] = (pulled, pushed, dt)
+    results[wid] = (pulled, pushed, dt, round_ms)
     client.close()
 
 
@@ -350,6 +353,13 @@ def self_check():
                 f"ps_load_test: docs/fault_tolerance.md no longer states "
                 f"the drill timing `{token}` — keep the doc's failover "
                 "timeline in sync with PS_LOAD_HB_S/PS_LOAD_HB_TIMEOUT_S")
+    # latency percentiles must come from the shared core/slo.py
+    # estimator (same implementation as serve_load_test/online_drill)
+    with open(os.path.abspath(__file__)) as f:
+        self_src = f.read()
+    if "from paddle_tpu.core.slo import percentile" not in self_src:
+        problems.append("ps_load_test: round-latency percentiles must "
+                        "come from core.slo.percentile")
     return problems
 
 
@@ -393,6 +403,14 @@ def main():
     print(f"pull rows/sec: {pull_sec:,.0f}")
     print(f"push rows/sec: {push_sec:,.0f}")
     print(f"aggregate rows/sec: {rows_sec:,.0f} (wall {wall:.2f}s)")
+    # per-round (pull+push) latency through the SHARED estimator
+    # (core/slo.py) so this line is comparable with serve_load_test's
+    # ttft percentiles and online_drill's round percentiles
+    from paddle_tpu.core.slo import percentile
+    round_ms = [ms for r in results.values() for ms in r[3]]
+    print(f"round latency ms: p50={percentile(round_ms, 50, ndigits=3)} "
+          f"p99={percentile(round_ms, 99, ndigits=3)} "
+          f"(n={len(round_ms)})")
     from paddle_tpu.core import monitor
     health = {k: int(v) for k, v in sorted(monitor.stats("ps.").items())}
     print(f"transport health counters: {health or 'all zero'}")

@@ -161,8 +161,13 @@ def run():
             else:
                 verified += 1
 
+    # ONE percentile estimator across serve_load_test / ps_load_test /
+    # online_drill (core/slo.py) — the numbers in the three reports are
+    # comparable because they share the implementation
+    from paddle_tpu.core.slo import percentile
+
     def pct(xs, p):
-        return round(float(np.percentile(xs, p)), 3) if xs else None
+        return percentile(xs, p, ndigits=3)
 
     snap = {k: v for k, v in monitor.stats("serve.").items()}
     report = {
@@ -266,6 +271,24 @@ def self_check():
             problems.append(
                 f"serve_load_test: docs/serving.md no longer mentions "
                 f"`{token}`")
+    # the p50/p99 lines must come from the shared estimator, and it must
+    # round-trip the exact values this report's pins were written against
+    try:
+        from paddle_tpu.core.slo import percentile
+        if percentile([1.0, 2.0, 3.0, 4.0], 50, ndigits=3) != 2.5:
+            problems.append("serve_load_test: core.slo.percentile no "
+                            "longer matches np.percentile semantics")
+        if percentile([], 99, ndigits=3) is not None:
+            problems.append("serve_load_test: core.slo.percentile([]) "
+                            "must be None (empty stream)")
+    except Exception as e:
+        problems.append(
+            f"serve_load_test: shared percentile estimator gone: {e!r}")
+    with open(os.path.abspath(__file__)) as f:
+        self_src = f.read()
+    if "from paddle_tpu.core.slo import percentile" not in self_src:
+        problems.append("serve_load_test: report percentiles must come "
+                        "from core.slo.percentile (shared estimator)")
     return problems
 
 
