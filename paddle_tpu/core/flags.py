@@ -113,6 +113,23 @@ define_flag("FLAGS_spmd_plan_pp_hbm_weight", 1.0,
 define_flag("FLAGS_spmd_plan_pp_bubble_weight", 1.0,
             "stage-cut objective weight on the bubble cost "
             "bubble_fraction * total FLOPs (idle compute)")
+define_flag("FLAGS_topology_ici_gbps", 90.0,
+            "assumed per-device intra-pod (ICI) link bandwidth in GB/s "
+            "for the two-tier topology cost model (mesh.axis_tiers / "
+            "spmd_analyzer per-collective cost_us pricing)")
+define_flag("FLAGS_topology_dcn_gbps", 6.25,
+            "assumed per-device inter-pod (DCN) link bandwidth in GB/s — "
+            "an order of magnitude below ICI, the cliff the hierarchical "
+            "dp sync decomposition exists to avoid")
+define_flag("FLAGS_topology_localsgd_k", 4,
+            "k_steps the topology report prices the LocalSGD degraded "
+            "sync mode with (one cross-replica average every k local "
+            "steps amortizes the dp sync wire bytes by 1/k)")
+define_flag("FLAGS_topology_localsgd_ratio", 8.0,
+            "DCN-dominance threshold: when even the HIERARCHICAL dp "
+            "sync's inter-pod cost_us exceeds its intra-pod cost_us by "
+            "this factor, the topology report recommends the LocalSGD "
+            "degraded mode instead (accuracy-for-bandwidth trade)")
 define_flag("FLAGS_use_flash_attention", True,
             "route attention through the Pallas flash kernel on TPU "
             "(paddle_tpu.ops.pallas.flash_attention)")

@@ -20,6 +20,7 @@ _LAZY_NAMES = {
     "alltoall": "collective", "barrier": "collective",
     "broadcast": "collective", "recv": "collective", "reduce": "collective",
     "reduce_scatter": "collective", "scatter": "collective",
+    "hierarchical_all_reduce": "collective",
     "send": "collective", "ReduceOp": "collective", "split": "collective",
     "DataParallel": "parallel", "init_parallel_env": "parallel",
     "ring_attention_fn": "ring_attention",

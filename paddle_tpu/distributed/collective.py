@@ -24,8 +24,9 @@ from . import mesh as mesh_mod
 from .env import get_world_size
 
 __all__ = ["ReduceOp", "all_reduce", "all_gather", "reduce", "broadcast",
-           "scatter", "alltoall", "reduce_scatter", "send", "recv", "barrier",
-           "split", "new_group", "wait", "get_group"]
+           "scatter", "alltoall", "reduce_scatter", "hierarchical_all_reduce",
+           "send", "recv", "barrier", "split", "new_group", "wait",
+           "get_group"]
 
 
 class ReduceOp:
@@ -365,6 +366,57 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
         raise RuntimeError("reduce_scatter outside SPMD region")
     x = ops.concat(tensor_list, axis=0) if tensor_list else tensor
     out = _reduce_scatter_raw(x, axis=axis, op=op)
+    if isinstance(tensor, Tensor):
+        tensor._rebind(out)
+        return tensor
+    return out
+
+
+@defop(name="c_hierarchical_allreduce")
+def _hierarchical_allreduce_raw(x, inner_axis, outer_axis, op):
+    """Pod-aware three-phase all-reduce — the decomposition
+    spmd_analyzer.SpmdReport.hierarchical_sync prices: reduce-scatter
+    over the fast `inner_axis` (ICI), all-reduce the resulting 1/n shard
+    over the slow `outer_axis` (DCN), then all-gather the shard back
+    over `inner_axis`. Numerically equal to a psum over both axes for
+    SUM/AVG while shrinking the inter-pod payload by the inner axis
+    size. MAX/MIN/PROD have no scatter decomposition — they nest the
+    flat form per axis (same wire shape, still axis-local traffic)."""
+    n_in = mesh_mod.mesh_axis_size(inner_axis)
+    n_out = mesh_mod.mesh_axis_size(outer_axis)
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        red = _allreduce_raw.raw(x, inner_axis, op, None)
+        return _allreduce_raw.raw(red, outer_axis, op, None)
+    shape = x.shape
+    flat = jnp.reshape(x, (-1,))
+    pad = (-flat.shape[0]) % n_in
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    shard = lax.psum_scatter(flat, inner_axis, scatter_dimension=0,
+                             tiled=True)
+    shard = lax.psum(shard, outer_axis)
+    full = lax.all_gather(shard, inner_axis, axis=0, tiled=True)
+    if pad:
+        full = full[:-pad]
+    out = jnp.reshape(full, shape)
+    if op == ReduceOp.AVG:
+        out = out / (n_in * n_out)
+    return out
+
+
+def hierarchical_all_reduce(tensor, op=ReduceOp.SUM, inner_axis="dp",
+                            outer_axis="pod", sync_op=True):
+    """All-reduce across a nested two-tier mesh: intra-pod reduce-scatter,
+    inter-pod all-reduce of the 1/n shard, intra-pod all-gather. Selected
+    by ShardingPlan.as_strategy() when the planned mesh declares a slow
+    tier; degrades to a plain all_reduce when either axis is unbound or
+    trivial, so flat-mesh callers keep flat-mesh semantics."""
+    if not _in_region(inner_axis):
+        return all_reduce(tensor, op=op, group=outer_axis)
+    if not _in_region(outer_axis):
+        return all_reduce(tensor, op=op, group=inner_axis)
+    out = _hierarchical_allreduce_raw(tensor, inner_axis=inner_axis,
+                                      outer_axis=outer_axis, op=op)
     if isinstance(tensor, Tensor):
         tensor._rebind(out)
         return tensor

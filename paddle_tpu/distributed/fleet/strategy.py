@@ -69,6 +69,15 @@ class DistributedStrategy:
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 1}
         self.adaptive_localsgd = False
+        # hierarchical_allreduce: dp gradient sync as the three-phase
+        # pod-aware decomposition (collective.hierarchical_all_reduce:
+        # reduce-scatter over inner_axes, all-reduce the shard over
+        # outer_axes, all-gather back). Flipped by
+        # ShardingPlan.as_strategy() when the planned mesh declares a
+        # slow link tier and the cost model recommends it.
+        self.hierarchical_allreduce = False
+        self.hierarchical_allreduce_configs = {"inner_axes": [],
+                                               "outer_axes": []}
         self.a_sync = False
         self.a_sync_configs = {}
         self.elastic = False

@@ -23,7 +23,71 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["init_mesh", "init_hybrid_mesh", "get_mesh", "set_mesh",
            "reset_mesh", "mesh_axis_size", "in_spmd_region",
-           "named_sharding", "MeshGuard", "auto_mesh", "shard_map"]
+           "named_sharding", "MeshGuard", "auto_mesh", "shard_map",
+           "axis_sizes", "axis_tiers", "LINK_TIERS", "DEFAULT_TIER"]
+
+# ---------------------------------------------------------------------------
+# two-tier topology grammar. A mesh description's axis value is either a
+# plain int size (legacy form, link tier defaults to ICI) or a dict
+#   {"size": 2, "tier": "dcn"[, "gbps": 25.0]}
+# declaring the link tier the axis crosses: "ici" for intra-pod chip
+# links, "dcn" for the inter-pod data-center network, an order of
+# magnitude slower (SURVEY §2.3 DCN row; MLPerf TPU-v3 pod scaling).
+# Per-device link bandwidths default from FLAGS_topology_{ici,dcn}_gbps
+# so the cost model is tunable without touching call sites.
+# ---------------------------------------------------------------------------
+
+LINK_TIERS = ("ici", "dcn")
+DEFAULT_TIER = "ici"
+
+
+def _tier_gbps(tier: str) -> float:
+    from ..core.flags import flag as _flag
+    if tier == "dcn":
+        return float(_flag("FLAGS_topology_dcn_gbps"))
+    return float(_flag("FLAGS_topology_ici_gbps"))
+
+
+def _axis_entry(value):
+    """(size, tier_meta | None) for one axis value of a mesh description."""
+    if isinstance(value, dict):
+        size = int(value.get("size", 1))
+        tier = str(value.get("tier", DEFAULT_TIER))
+        if tier not in LINK_TIERS:
+            raise ValueError(
+                f"unknown link tier {tier!r} (choose from {LINK_TIERS})")
+        gbps = float(value.get("gbps", _tier_gbps(tier)))
+        return size, {"tier": tier, "gbps": gbps}
+    return int(value), None
+
+
+def axis_sizes(shape: Dict[str, object]) -> Dict[str, int]:
+    """{axis: int} from a mesh description dict, tier grammar accepted."""
+    return {str(k): _axis_entry(v)[0] for k, v in shape.items()}
+
+
+def axis_tiers(mesh_or_shape) -> Dict[str, dict]:
+    """{axis: {"tier": str, "gbps": float}} for every axis of a mesh
+    description dict or a Mesh. Axes without declared tier metadata get
+    the ICI default; a Mesh carries its tiers in `_link_tiers` (attached
+    by init_mesh tier grammar / init_hybrid_mesh DCN layering)."""
+    out: Dict[str, dict] = {}
+    if mesh_or_shape is None:
+        return out
+    if isinstance(mesh_or_shape, dict):
+        for k, v in mesh_or_shape.items():
+            _, meta = _axis_entry(v)
+            out[str(k)] = meta or {"tier": DEFAULT_TIER,
+                                   "gbps": _tier_gbps(DEFAULT_TIER)}
+        return out
+    declared = dict(getattr(mesh_or_shape, "_link_tiers", {}) or {})
+    for name in getattr(mesh_or_shape, "axis_names", ()):
+        meta = declared.get(name)
+        if isinstance(meta, str):
+            meta = {"tier": meta, "gbps": _tier_gbps(meta)}
+        out[str(name)] = dict(meta) if meta else \
+            {"tier": DEFAULT_TIER, "gbps": _tier_gbps(DEFAULT_TIER)}
+    return out
 
 
 def shard_map(f, mesh=None, in_specs=None, out_specs=None, check=False):
@@ -57,12 +121,18 @@ def init_mesh(shape: Dict[str, int] = None, name: str = "default",
     """Declare a named mesh once (the c_comm_init analog).
 
     shape: ordered {axis_name: size}; product must equal device count.
+    Axis values may use the tier grammar ({"size": 2, "tier": "dcn"}) —
+    sizes build the device array, tier metadata rides the Mesh as
+    `_link_tiers` for the topology cost model (axis_tiers).
     Defaults to a pure data-parallel mesh over all devices.
     """
     global _default_name
     devices = list(devices if devices is not None else jax.devices())
     if shape is None:
         shape = {"dp": len(devices)}
+    tiers = {k: m for k, m in
+             ((k, _axis_entry(v)[1]) for k, v in shape.items()) if m}
+    shape = axis_sizes(shape)
     sizes = list(shape.values())
     need = int(np.prod(sizes))
     if need > len(devices):
@@ -70,6 +140,10 @@ def init_mesh(shape: Dict[str, int] = None, name: str = "default",
             f"mesh shape {shape} needs {need} devices, have {len(devices)}")
     arr = np.array(devices[:need]).reshape(sizes)  # sub-mesh allowed
     mesh = Mesh(arr, tuple(shape.keys()))
+    # always (re)assign: jax interns equivalent Mesh objects, so a stale
+    # _link_tiers from an earlier same-shape mesh must not leak through
+    # (object.__setattr__ — jax's Mesh forbids ordinary reassignment)
+    object.__setattr__(mesh, "_link_tiers", tiers)
     with _lock:
         _meshes[name] = mesh
         if _default_name is None or name == "default":
@@ -134,6 +208,10 @@ def init_hybrid_mesh(ici_shape: Dict[str, int],
     arr = np.array([sorted(s, key=lambda d: d.id) for s in slices])
     arr = arr.reshape(list(dcn_shape.values()) + list(ici_shape.values()))
     mesh = Mesh(arr, tuple(dcn_shape.keys()) + tuple(ici_shape.keys()))
+    # the DCN axes cross the slow tier by construction — tag them so the
+    # topology cost model (axis_tiers / spmd_analyzer) prices them as such
+    object.__setattr__(mesh, "_link_tiers", {
+        ax: {"tier": "dcn", "gbps": _tier_gbps("dcn")} for ax in dcn_shape})
     return set_mesh(mesh, name)
 
 

@@ -213,7 +213,8 @@ def bubble_fraction(num_micro: int, num_stages: int,
 
 def schedule_collectives(num_micro: int, num_stages: int,
                          hidden_bytes: int, schedule: str = "gpipe",
-                         num_virtual: int = 1, axis: str = "pp") -> dict:
+                         num_virtual: int = 1, axis: str = "pp",
+                         tiers=None) -> dict:
     """The pipeline's implied collective set, in the static analyzer's
     terms (static/spmd_analyzer.py): every schedule above emits ONE
     lax.ppermute of the hidden microbatch per tick, so the 'pp' wire
@@ -223,11 +224,25 @@ def schedule_collectives(num_micro: int, num_stages: int,
     ppermute in reverse, doubling the wire bytes for training.)
 
     A single-stage pipeline has no ring to permute around — it prices
-    as ZERO ppermutes, not `ticks` no-op sends."""
+    as ZERO ppermutes, not `ticks` no-op sends.
+
+    `tiers` ({axis: {"tier", "gbps"}}, the mesh.axis_tiers form) adds
+    `tier`/`cost_us` keys pricing the wire against the stage axis's
+    link — a pipeline axis left on the slow DCN tier shows its cost
+    here before a single microbatch moves."""
     if max(int(num_stages), 1) <= 1:
-        return {"kind": "ppermute", "axis": axis, "count": 0,
-                "bytes_per_tick": int(hidden_bytes), "total_bytes": 0}
-    ticks = schedule_ticks(num_micro, num_stages, schedule, num_virtual)
-    return {"kind": "ppermute", "axis": axis, "count": ticks,
-            "bytes_per_tick": int(hidden_bytes),
-            "total_bytes": ticks * int(hidden_bytes)}
+        out = {"kind": "ppermute", "axis": axis, "count": 0,
+               "bytes_per_tick": int(hidden_bytes), "total_bytes": 0}
+    else:
+        ticks = schedule_ticks(num_micro, num_stages, schedule,
+                               num_virtual)
+        out = {"kind": "ppermute", "axis": axis, "count": ticks,
+               "bytes_per_tick": int(hidden_bytes),
+               "total_bytes": ticks * int(hidden_bytes)}
+    if tiers and axis in tiers:
+        m = tiers[axis]
+        g = float(m.get("gbps", 0.0))
+        out["tier"] = str(m.get("tier", "ici"))
+        out["cost_us"] = round(out["total_bytes"] / (g * 1e3), 3) \
+            if g > 0 else 0.0
+    return out

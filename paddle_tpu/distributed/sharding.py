@@ -168,7 +168,10 @@ def mesh_like(mesh):
         return mesh_mod.get_mesh()
     if isinstance(mesh, dict):
         from types import SimpleNamespace
-        return SimpleNamespace(axis_names=tuple(mesh), shape=dict(mesh))
+        # axis_sizes flattens the topology grammar ({axis: {"size": n,
+        # "tier": ...}}) down to plain int sizes for spec derivation
+        return SimpleNamespace(axis_names=tuple(mesh),
+                               shape=mesh_mod.axis_sizes(mesh))
     return mesh
 
 

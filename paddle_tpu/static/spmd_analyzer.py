@@ -64,6 +64,10 @@ DIAGNOSTIC_CODES = (
     "reshard",          # spec conflict forcing an implicit all-gather
     "collective-divergence",  # cond branches imply different collective
                               # sequences (single-program SPMD invariant)
+    "cross-tier",       # a recurring collective rides a slow-tier (DCN)
+                        # link — model parallelism left crossing the pod
+                        # boundary; only the dp gradient sync should
+                        # cross, and hierarchically (hierarchical_sync)
 )
 
 
@@ -103,13 +107,30 @@ class SpmdDiagnostic:
         return f"{self.code}{where}: {self.message}"
 
 
+def _wire_dtype(dtype) -> np.dtype:
+    """np.dtype that also resolves the ml_dtypes family by name
+    ('float8_e4m3fn', 'float8_e5m2', 'bfloat16', ...) — numpy's own
+    registry rejects the fp8 names the quantized-collective seam prices."""
+    try:
+        return np.dtype(dtype)
+    except (TypeError, ValueError):
+        import ml_dtypes
+        t = getattr(ml_dtypes, str(dtype), None)
+        if t is None:
+            raise
+        return np.dtype(t)
+
+
 @dataclass
 class Collective:
     """One implied collective. `bytes` is the per-device payload: the
     tensor's logical nbytes divided by the shard divisor of the dims NOT
     taking part in the communication. `dtype` is the element type riding
     the wire (numpy name), so quantized-collective analysis can re-price
-    the payload under a narrower cast without re-walking the program."""
+    the payload under a narrower cast without re-walking the program.
+    `tier`/`cost_us` price the payload against the two-tier topology
+    model when the mesh declares per-axis link tiers (mesh.axis_tiers);
+    on a flat mesh they stay at the defaults."""
     kind: str          # all_reduce | all_gather
     axis: str          # mesh axis (comma-joined when a dim carries several)
     bytes: int
@@ -117,6 +138,8 @@ class Collective:
     op_name: Optional[str] = None
     var: Optional[str] = None
     dtype: Optional[str] = None
+    tier: str = "ici"  # slowest link tier the payload rides
+    cost_us: float = 0.0  # bytes / (link GB/s * 1e3); 0 on flat meshes
 
     def bytes_if(self, dtype) -> int:
         """Per-device payload bytes if the wire format were `dtype`
@@ -124,14 +147,16 @@ class Collective:
         collectives keep the element COUNT, shrink the element size)."""
         if self.dtype is None:
             return self.bytes
-        old = np.dtype(self.dtype).itemsize
-        new = np.dtype(dtype).itemsize
+        old = _wire_dtype(self.dtype).itemsize
+        new = _wire_dtype(dtype).itemsize
         return (self.bytes * new) // max(old, 1)
 
     @property
     def is_float(self) -> bool:
-        return self.dtype is not None and \
-            np.issubdtype(np.dtype(self.dtype), np.floating)
+        if self.dtype is None:
+            return False
+        d = _wire_dtype(self.dtype)
+        return d.kind == "f" or d.name.startswith(("float", "bfloat"))
 
 
 def _spec_str(entries) -> str:
@@ -156,9 +181,139 @@ class SpmdReport:
     hbm: Optional[dict] = None             # analyze_memory, per-device
     hbm_replicated: Optional[dict] = None  # same program, no sharding
     unknown_ops: set = field(default_factory=set)
+    mesh_tiers: Dict[str, dict] = field(default_factory=dict)
+    # ^ axis -> {"tier", "gbps"}; empty on a flat (single-tier) mesh
+    dp_axes: Tuple[str, ...] = ()
+    # ^ pure data-parallel axes: shard a feed but no persistable — the
+    #   axes whose gradient sync the hierarchical decomposition targets
 
     def collective_bytes(self) -> int:
         return sum(c.bytes for c in self.collectives)
+
+    def tier_bytes(self) -> Dict[str, int]:
+        """Wire bytes per link tier (a collective counts toward the
+        slowest tier it touches)."""
+        out: Dict[str, int] = {}
+        for c in self.collectives:
+            out[c.tier] = out.get(c.tier, 0) + c.bytes
+        return out
+
+    def _axis_gbps(self, axis) -> float:
+        gs = [float(self.mesh_tiers[ax]["gbps"])
+              for ax in str(axis).split(",")
+              if ax in self.mesh_tiers
+              and float(self.mesh_tiers[ax].get("gbps", 0)) > 0]
+        return min(gs) if gs else 0.0
+
+    def weighted_collective_bytes(self, kind=None) -> float:
+        """Collective bytes with each payload scaled by how much slower
+        its link is than the fastest declared tier — the planner's
+        topology-aware objective term. Equals collective_bytes() on a
+        flat mesh, so single-tier plans and goldens are unchanged.
+        `kind` restricts to one collective kind (e.g. "all_reduce")."""
+        cs = [c for c in self.collectives
+              if kind is None or c.kind == kind]
+        if not self.mesh_tiers:
+            return float(sum(c.bytes for c in cs))
+        top = max((float(m.get("gbps", 0.0))
+                   for m in self.mesh_tiers.values()), default=0.0)
+        if top <= 0:
+            return float(sum(c.bytes for c in cs))
+        total = 0.0
+        for c in cs:
+            g = self._axis_gbps(c.axis)
+            total += c.bytes * (top / g if g > 0 else 1.0)
+        return total
+
+    def hierarchical_sync(self, grad_bytes=None, k_steps=None
+                          ) -> Optional[dict]:
+        """Price the pure-dp gradient sync three ways over the two-tier
+        mesh: a flat all-reduce over every dp axis, the hierarchical
+        decomposition (reduce-scatter intra-pod -> inter-pod all-reduce
+        over the 1/n shard -> all-gather intra-pod), and LocalSGD (flat
+        sync every k steps). Per-device ring wire model: an all-reduce
+        of B bytes over an axis of size s moves 2*B*(s-1)/s per device.
+        `grad_bytes` defaults to the per-device param bytes from the HBM
+        estimate. Returns None on a flat mesh or when no pure-dp axis
+        exists."""
+        from ..core.flags import flag as _flag
+        tiers = self.mesh_tiers or {}
+        if not tiers:
+            return None
+        dp = [a for a in self.dp_axes if a in self.mesh_axes]
+        if not dp:
+            return None
+        if grad_bytes is None:
+            grad_bytes = int((self.hbm or {}).get("param_bytes", 0))
+        if k_steps is None:
+            k_steps = int(_flag("FLAGS_topology_localsgd_k"))
+
+        def meta(ax):
+            return tiers.get(ax) or {
+                "tier": "ici",
+                "gbps": float(_flag("FLAGS_topology_ici_gbps"))}
+
+        top = max((float(m.get("gbps", 0.0)) for m in tiers.values()),
+                  default=0.0)
+        slow = [a for a in dp if 0 < float(meta(a)["gbps"]) < top]
+        fast = [a for a in dp if a not in slow]
+        n = 1
+        for a in fast:
+            n *= self.mesh_axes[a]
+        pods = 1
+        for a in slow:
+            pods *= self.mesh_axes[a]
+
+        def ring(b, size):
+            return 0 if size <= 1 else (2 * int(b) * (size - 1)) // size
+
+        B = int(grad_bytes)
+        flat = {"ici": ring(B, n), "dcn": ring(B, pods)}
+        hier = {"ici": ring(B, n), "dcn": ring(B // max(n, 1), pods)}
+        local = {t: b // max(int(k_steps), 1) for t, b in flat.items()}
+        gs_fast = [float(meta(a)["gbps"]) for a in fast]
+        gs_slow = [float(meta(a)["gbps"]) for a in slow]
+        ici_g = min(gs_fast) if gs_fast else \
+            float(_flag("FLAGS_topology_ici_gbps"))
+        dcn_g = min(gs_slow) if gs_slow else \
+            float(_flag("FLAGS_topology_dcn_gbps"))
+
+        def cost(wire):
+            return {"ici": wire["ici"] / (ici_g * 1e3) if ici_g else 0.0,
+                    "dcn": wire["dcn"] / (dcn_g * 1e3) if dcn_g else 0.0}
+
+        schemes = {}
+        raw_costs = {}
+        for name, wire in (("flat", flat), ("hierarchical", hier),
+                           ("localsgd", local)):
+            c = cost(wire)
+            raw_costs[name] = c
+            schemes[name] = {
+                "wire_bytes": dict(wire),
+                "cost_us": {k: round(v, 3) for k, v in c.items()},
+                "total_cost_us": round(sum(c.values()), 3)}
+        reduction = (flat["dcn"] / hier["dcn"]) if hier["dcn"] \
+            else float(n if pods > 1 else 1)
+        hc = raw_costs["hierarchical"]
+        ratio = (hc["dcn"] / hc["ici"]) if hc["ici"] > 0 else None
+        if pods <= 1 or n <= 1:
+            # no slow boundary to hide, or no inner axis to shard the
+            # inter-pod payload over — the decomposition buys nothing
+            rec = "flat"
+        elif ratio is not None and \
+                ratio > float(_flag("FLAGS_topology_localsgd_ratio")):
+            rec = "localsgd"
+        else:
+            rec = "hierarchical"
+        return {"grad_bytes": B, "dp_axes": list(dp),
+                "inner": {"axes": fast, "size": n},
+                "outer": {"axes": slow, "size": pods},
+                "schemes": schemes,
+                "inter_pod_reduction_x": round(float(reduction), 3),
+                "dcn_over_ici_cost":
+                    round(ratio, 3) if ratio is not None else None,
+                "recommendation": rec,
+                "localsgd_k": int(k_steps)}
 
     def resharding_count(self) -> int:
         return sum(1 for d in self.diagnostics if d.code == "reshard")
@@ -206,17 +361,35 @@ class SpmdReport:
         """Human-readable report (tools/spmd_lint.py)."""
         lines = ["spmd analysis: mesh {" + ", ".join(
             f"{a}:{s}" for a, s in self.mesh_axes.items()) + "}"]
+        if self.mesh_tiers:
+            by_tier: Dict[tuple, List[str]] = {}
+            for ax, m in self.mesh_tiers.items():
+                by_tier.setdefault(
+                    (str(m["tier"]), float(m["gbps"])), []).append(ax)
+            lines.append("link tiers: " + "; ".join(
+                f"{','.join(axs)}={t}@{g:g}GB/s"
+                for (t, g), axs in sorted(by_tier.items())))
         if self.collectives:
             by_key: Dict[tuple, List[Collective]] = {}
             for c in self.collectives:
                 by_key.setdefault((c.kind, c.axis), []).append(c)
             lines.append("collectives per step:")
-            lines.append(f"  {'kind':<12}{'axis':<8}{'count':>6}"
-                         f"{'bytes':>14}")
+            hdr = f"  {'kind':<12}{'axis':<8}{'count':>6}{'bytes':>14}"
+            if self.mesh_tiers:
+                hdr += f"{'tier':>6}{'cost_us':>10}"
+            lines.append(hdr)
             for (kind, axis), cs in sorted(by_key.items()):
-                lines.append(f"  {kind:<12}{axis:<8}{len(cs):>6}"
-                             f"{sum(c.bytes for c in cs):>14}")
+                row = (f"  {kind:<12}{axis:<8}{len(cs):>6}"
+                       f"{sum(c.bytes for c in cs):>14}")
+                if self.mesh_tiers:
+                    row += (f"{cs[0].tier:>6}"
+                            f"{sum(c.cost_us for c in cs):>10.1f}")
+                lines.append(row)
             lines.append(f"collective bytes/step: {self.collective_bytes()}")
+            if self.mesh_tiers:
+                lines.append("wire bytes per tier: " + ", ".join(
+                    f"{t}={b}" for t, b in sorted(
+                        self.tier_bytes().items())))
             savings = self.quantized_savings("int8")
             if any(row["saved"] for row in savings.values()):
                 lines.append("int8/fp8 quantized collectives would save "
@@ -231,6 +404,22 @@ class SpmdReport:
                         f"(saves {row['saved']} B, {ratio:.1f}x)")
         else:
             lines.append("collectives per step: none")
+        if self.mesh_tiers:
+            hs = self.hierarchical_sync()
+            if hs:
+                lines.append(
+                    f"dp gradient sync ({'+'.join(hs['dp_axes'])}, "
+                    f"{hs['grad_bytes']} B grads, per device):")
+                for name in ("flat", "hierarchical", "localsgd"):
+                    s = hs["schemes"][name]
+                    lines.append(
+                        f"  {name:<14}ici {s['wire_bytes']['ici']:>12} B"
+                        f"  dcn {s['wire_bytes']['dcn']:>12} B"
+                        f"  {s['total_cost_us']:>12.1f} us")
+                lines.append(
+                    "  hierarchical cuts inter-pod bytes "
+                    f"{hs['inter_pod_reduction_x']:.1f}x vs flat; "
+                    f"recommended: {hs['recommendation']}")
         if self.hbm:
             lines.append(
                 f"per-device HBM estimate: peak {self.hbm['peak_bytes']} "
@@ -257,17 +446,32 @@ class SpmdReport:
 # form of jax.sharding.PartitionSpec.
 # ---------------------------------------------------------------------------
 
-def _mesh_axes(mesh) -> Dict[str, int]:
-    """Axis name -> size from a Mesh, an {axis: size} dict (no devices
-    needed — lint a pod layout from a laptop), or the registered default."""
+def _mesh_topology(mesh) -> Tuple[Dict[str, int], Dict[str, dict]]:
+    """(axes, tiers) from a Mesh, an {axis: size-or-tier-dict} dict (no
+    devices needed — lint a pod layout from a laptop), or the registered
+    default. `tiers` is {} when the mesh is flat — every axis on the
+    default tier at the default bandwidth — so single-tier reports stay
+    byte-identical to pre-topology output."""
+    from ..distributed import mesh as mesh_mod
     if mesh is None:
-        from ..distributed import mesh as mesh_mod
         mesh = mesh_mod.get_mesh()
     if mesh is None:
-        return {}
+        return {}, {}
     if isinstance(mesh, dict):
-        return {str(k): int(v) for k, v in mesh.items()}
-    return {str(n): int(mesh.shape[n]) for n in mesh.axis_names}
+        axes = mesh_mod.axis_sizes(mesh)
+    else:
+        axes = {str(n): int(mesh.shape[n]) for n in mesh.axis_names}
+    tiers = mesh_mod.axis_tiers(mesh)
+    base = mesh_mod._tier_gbps(mesh_mod.DEFAULT_TIER)
+    if all(m["tier"] == mesh_mod.DEFAULT_TIER and
+           float(m["gbps"]) == base for m in tiers.values()):
+        tiers = {}
+    return axes, tiers
+
+
+def _mesh_axes(mesh) -> Dict[str, int]:
+    """Axis name -> size (the size half of _mesh_topology)."""
+    return _mesh_topology(mesh)[0]
 
 
 def _norm_entry(e) -> tuple:
@@ -326,6 +530,12 @@ class _Ctx:
         self.label = label  # "cond#5/true/" inside sub-block walks
         self.op_index: Optional[int] = None
         self.op_name: Optional[str] = None
+        self.tiers = report.mesh_tiers or {}
+        gs = [float(m.get("gbps", 0.0)) for m in self.tiers.values()
+              if float(m.get("gbps", 0.0)) > 0]
+        self._top_gbps = max(gs, default=0.0)
+        self.slow_axes = {ax for ax, m in self.tiers.items()
+                          if 0 < float(m.get("gbps", 0.0)) < self._top_gbps}
 
     def child(self, label: str = ""):
         return _Ctx(self.axes, self.report, collectives=[],
@@ -359,10 +569,22 @@ class _Ctx:
                    dtype=None):
         if dtype is None and aval is not None:
             dtype = np.dtype(aval.dtype).name
+        axes = tuple(entry.split(",")) if isinstance(entry, str) \
+            else tuple(entry)
+        tier, cost = "ici", 0.0
+        if self.tiers:
+            metas = [self.tiers[ax] for ax in axes if ax in self.tiers]
+            if metas:
+                slowest = min(
+                    metas, key=lambda m: float(m.get("gbps", 0.0)) or
+                    float("inf"))
+                tier = str(slowest.get("tier", tier))
+                g = float(slowest.get("gbps", 0.0))
+                cost = float(bytes_) / (g * 1e3) if g > 0 else 0.0
         self.collectives.append(Collective(
-            kind=kind, axis=",".join(entry) if not isinstance(entry, str)
-            else entry, bytes=int(bytes_), op_index=self.op_index,
-            op_name=self.op_name, var=var, dtype=dtype))
+            kind=kind, axis=",".join(axes), bytes=int(bytes_),
+            op_index=self.op_index, op_name=self.op_name, var=var,
+            dtype=dtype, tier=tier, cost_us=cost))
 
     def diag(self, code, message, var=None, axis=None):
         self.report.diagnostics.append(SpmdDiagnostic(
@@ -1324,8 +1546,8 @@ def analyze_program(program: Program, mesh=None, param_specs=None,
     Returns an SpmdReport: resolved specs per var, the implied collective
     set, the diagnostic list, and per-device/replicated HBM estimates.
     """
-    axes = _mesh_axes(mesh)
-    report = SpmdReport(mesh_axes=dict(axes))
+    axes, tiers = _mesh_topology(mesh)
+    report = SpmdReport(mesh_axes=dict(axes), mesh_tiers=tiers)
     ctx = _Ctx(axes, report)
     if param_specs is None:
         param_specs = _derive_param_specs(program, axes)
@@ -1361,6 +1583,36 @@ def analyze_program(program: Program, mesh=None, param_specs=None,
     report.specs = env_spec
     report.var_names = names
 
+    # Pure data-parallel axes: shard a feed but no persistable. Their
+    # steady-state traffic is the gradient sync — the one flow that MAY
+    # cross a slow tier (hierarchically); everything else that touches a
+    # slow-tier link every step is a layout mistake, flagged below.
+    data_axes: set = set()
+    persist_axes: set = set()
+    for v in program.data_vars.values():
+        for e in env_spec.get(v.var_id, ()):
+            data_axes.update(e)
+    for vid in program.persist_ids.values():
+        for e in env_spec.get(vid, ()):
+            persist_axes.update(e)
+    report.dp_axes = tuple(sorted(data_axes - persist_axes))
+
+    if ctx.slow_axes:
+        exempt = set(report.dp_axes)
+        for c in report.collectives:
+            for ax in str(c.axis).split(","):
+                if ax in ctx.slow_axes and ax not in exempt:
+                    report.diagnostics.append(SpmdDiagnostic(
+                        code="cross-tier",
+                        message=f"{c.kind} of '{c.var}' rides slow-tier "
+                                f"axis '{ax}' "
+                                f"({ctx.tiers[ax]['tier']}) every step — "
+                                "keep model parallelism intra-pod; only "
+                                "the dp gradient sync should cross the "
+                                "slow tier, and hierarchically",
+                        op_name=c.op_name, op_index=c.op_index,
+                        var=c.var, axis=ax))
+
     divisors = {vid: ctx.spec_div(spec) for vid, spec in env_spec.items()}
     from .shape_infer import analyze_memory
     try:
@@ -1387,8 +1639,8 @@ def analyze_params(params, mesh=None, specs=None, tokens_per_step=None,
     """
     from ..distributed import sharding as sharding_mod
 
-    axes = _mesh_axes(mesh)
-    report = SpmdReport(mesh_axes=dict(axes))
+    axes, tiers = _mesh_topology(mesh)
+    report = SpmdReport(mesh_axes=dict(axes), mesh_tiers=tiers)
     ctx = _Ctx(axes, report)
     meshlike = sharding_mod.mesh_like(dict(axes))
     param_bytes = 0

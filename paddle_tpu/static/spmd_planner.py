@@ -56,7 +56,8 @@ from jax.sharding import PartitionSpec as P
 
 from .program import Program, _Ref
 from .spmd_analyzer import (SpmdReport, _entries as _spec_entries,
-                            _mesh_axes, _nbytes, analyze_program)
+                            _mesh_axes, _mesh_topology, _nbytes,
+                            analyze_program)
 
 __all__ = ["ShardingPlan", "PlanRule", "plan_program", "resolve_auto_shard",
            "name_template", "PipelinePlan", "StageCost", "plan_pipeline",
@@ -134,6 +135,11 @@ class ShardingPlan:
     baseline: Dict[str, Any] = field(default_factory=dict)  # replicated
     evaluations: int = 0
     pipeline: Optional["PipelinePlan"] = None  # stage cuts (plan_pipeline)
+    mesh_tiers: Dict[str, dict] = field(default_factory=dict)
+    # ^ per-axis link metadata; empty on a flat (single-tier) mesh
+    grad_sync: Optional[dict] = None
+    # ^ SpmdReport.hierarchical_sync() of the winning layout: the priced
+    #   flat/hierarchical/localsgd dp sync schemes + recommendation
 
     # -- consumption ---------------------------------------------------------
     def spec_for(self, name: str, ndim: int) -> P:
@@ -192,6 +198,21 @@ class ShardingPlan:
                 "pp_degree": pp.num_stages,
                 "stage_op_ranges": [tuple(s.op_range) for s in pp.stages],
             })
+        gs = self.grad_sync
+        if gs and gs.get("outer", {}).get("size", 1) > 1:
+            # two-tier mesh: pick the dp sync mode the cost model chose —
+            # the three-phase decomposition by default, LocalSGD when
+            # even the decomposed DCN leg dominates
+            if gs.get("recommendation") == "localsgd":
+                strategy.localsgd = True
+                strategy.localsgd_configs = dict(
+                    strategy.localsgd_configs or {},
+                    k_steps=int(gs.get("localsgd_k", 4)))
+            elif gs.get("recommendation") == "hierarchical":
+                strategy.hierarchical_allreduce = True
+                strategy.hierarchical_allreduce_configs = {
+                    "inner_axes": list(gs["inner"]["axes"]),
+                    "outer_axes": list(gs["outer"]["axes"])}
         return strategy
 
     def build_param_shardings(self, params: Dict[str, Any], mesh):
@@ -204,7 +225,20 @@ class ShardingPlan:
 
     # -- reporting -----------------------------------------------------------
     def to_json(self) -> Dict[str, Any]:
-        """Stable (sorted, primitive-typed) form for CI consumption."""
+        """Stable (sorted, primitive-typed) form for CI consumption.
+        Flat-mesh plans keep the pre-topology key set; the `topology`
+        block appears only when the mesh declares link tiers."""
+        out = self._base_json()
+        if self.mesh_tiers:
+            out["topology"] = {
+                "tiers": {ax: {"tier": str(m["tier"]),
+                               "gbps": float(m["gbps"])}
+                          for ax, m in sorted(self.mesh_tiers.items())},
+                "grad_sync": self.grad_sync,
+            }
+        return out
+
+    def _base_json(self) -> Dict[str, Any]:
         return {
             "mesh": dict(sorted(self.mesh_axes.items())),
             "rules": [{"template": r.template, "ndim": r.ndim,
@@ -225,6 +259,14 @@ class ShardingPlan:
     def render(self) -> str:
         lines = ["spmd plan: mesh {" + ", ".join(
             f"{a}:{s}" for a, s in self.mesh_axes.items()) + "}"]
+        if self.mesh_tiers:
+            by_tier: Dict[tuple, List[str]] = {}
+            for ax, m in self.mesh_tiers.items():
+                by_tier.setdefault(
+                    (str(m["tier"]), float(m["gbps"])), []).append(ax)
+            lines.append("link tiers: " + "; ".join(
+                f"{','.join(axs)}={t}@{g:g}GB/s"
+                for (t, g), axs in sorted(by_tier.items())))
         lines.append("rules:")
         for r in sorted(self.rules, key=lambda r: (r.template, r.ndim)):
             lines.append(f"  {r.template:<44} -> {r.spec}")
@@ -238,6 +280,16 @@ class ShardingPlan:
             f"peak HBM/device {p.get('hbm_peak', 0)} B "
             f"(replicated baseline: {b.get('collective_bytes', 0)} B, "
             f"{b.get('hbm_peak', 0)} B)")
+        gs = self.grad_sync
+        if gs:
+            red = gs.get("inter_pod_reduction_x", 1)
+            lines.append(
+                f"dp grad sync: {gs.get('recommendation')} "
+                f"(inner {'x'.join(map(str, gs['inner']['axes'])) or '-'}"
+                f":{gs['inner']['size']}, outer "
+                f"{'x'.join(map(str, gs['outer']['axes'])) or '-'}"
+                f":{gs['outer']['size']}, hierarchical cuts inter-pod "
+                f"bytes {red:.1f}x)")
         return "\n".join(lines)
 
 
@@ -366,20 +418,41 @@ def _param_candidates(g: PlanGroup, axes: Dict[str, int],
     return cands
 
 
-def _data_candidates(g: PlanGroup, axes: Dict[str, int]) -> List[tuple]:
+def _data_candidates(g: PlanGroup, axes: Dict[str, int],
+                     tiers: Optional[Dict[str, dict]] = None) -> List[tuple]:
     """Feeds admit batch-dp (dim 0) and sequence-sp (dim 1) sharding —
-    the repo's mesh-axis conventions (fleet hybrid degrees)."""
+    the repo's mesh-axis conventions (fleet hybrid degrees). On a
+    two-tier mesh the slow-tier axes also join the batch dim (alone or
+    outside `dp`, DCN-major), so the beam can push pure data
+    parallelism — and only that — across the pod boundary."""
     nd, shape = g.ndim, g.shape
     cands: List[tuple] = [((),) * nd]
+    top = max((float(m.get("gbps", 0.0))
+               for m in (tiers or {}).values()), default=0.0)
+    slow = [ax for ax, m in (tiers or {}).items()
+            if ax in axes and 0 < float(m.get("gbps", 0.0)) < top]
+
+    batch_entries: List[tuple] = []
+    if "dp" in axes:
+        batch_entries.append(("dp",))
+    for ax in sorted(slow):
+        batch_entries.append((ax,))
+        if "dp" in axes:
+            batch_entries.append((ax, "dp"))
+
     combos = []
-    dp_ok = "dp" in axes and nd >= 1 and shape[0] % axes["dp"] == 0
+    for ent in batch_entries:
+        size = 1
+        for ax in ent:
+            size *= axes[ax]
+        if nd >= 1 and shape[0] % size == 0:
+            combos.append({0: ent})
     sp_ok = "sp" in axes and nd >= 2 and shape[1] % axes["sp"] == 0
-    if dp_ok:
-        combos.append({0: ("dp",)})
+    base = list(combos)
     if sp_ok:
         combos.append({1: ("sp",)})
-    if dp_ok and sp_ok:
-        combos.append({0: ("dp",), 1: ("sp",)})
+        for c in base:
+            combos.append({**c, 1: ("sp",)})
     for combo in combos:
         spec = [combo.get(d, ()) for d in range(nd)]
         if tuple(spec) not in cands:
@@ -392,11 +465,16 @@ def _data_candidates(g: PlanGroup, axes: Dict[str, int]) -> List[tuple]:
 # ---------------------------------------------------------------------------
 
 class _Oracle:
-    """Memoized analyzer pricing of a full assignment."""
+    """Memoized analyzer pricing of a full assignment.
 
-    def __init__(self, program, axes, coll_w, hbm_w):
+    `mesh_desc` preserves the full topology grammar (per-axis link
+    tiers) so the analyzer prices slow-tier traffic at its real weight;
+    it defaults to the bare axes, which is the flat single-tier case."""
+
+    def __init__(self, program, axes, coll_w, hbm_w, mesh_desc=None):
         self.program = program
         self.axes = axes
+        self.mesh_desc = mesh_desc if mesh_desc is not None else axes
         self.coll_w = coll_w
         self.hbm_w = hbm_w
         self.cache: Dict[tuple, tuple] = {}
@@ -419,15 +497,17 @@ class _Oracle:
             return hit
         self.evaluations += 1
         report = analyze_program(
-            self.program, mesh=self.axes,
+            self.program, mesh=self.mesh_desc,
             param_specs={k: _to_p(v) for k, v in param_assign.items()},
             data_specs={k: _to_p(v) for k, v in data_assign.items()})
         hbm = report.hbm["peak_bytes"] if report.hbm else \
             sum(_nbytes(pv.aval)
                 for pv in self.program.persistable_vars.values())
-        score = self.coll_w * report.collective_bytes() + self.hbm_w * hbm
-        ar_bytes = sum(c.bytes for c in report.collectives
-                       if c.kind == "all_reduce")
+        # tier-weighted bytes == plain bytes on a flat mesh, so the
+        # single-tier goldens price (and rank) exactly as before
+        score = self.coll_w * report.weighted_collective_bytes() \
+            + self.hbm_w * hbm
+        ar_bytes = report.weighted_collective_bytes("all_reduce")
         opt = self.coll_w * ar_bytes + self.hbm_w * hbm
         out = (len(report.diagnostics), float(score), float(opt), report)
         self.cache[key] = out
@@ -435,7 +515,7 @@ class _Oracle:
 
 
 def _build_groups(program: Program, axes, names, zero_dp,
-                  fixed_data_specs) -> List[PlanGroup]:
+                  fixed_data_specs, tiers=None) -> List[PlanGroup]:
     roles, first = _scan_roles(program)
     names = dict(names or {})
     by_tmpl: Dict[tuple, PlanGroup] = {}
@@ -470,7 +550,7 @@ def _build_groups(program: Program, axes, names, zero_dp,
                           ndim=len(v.aval.shape),
                           shape=tuple(v.aval.shape),
                           nbytes=_nbytes(v.aval), first_use=-1)
-            g.candidates = _data_candidates(g, axes)
+            g.candidates = _data_candidates(g, axes, tiers)
             groups.append(g)
 
     # dataflow order: feeds first (they enter at op 0), then params by
@@ -497,7 +577,11 @@ def plan_program(program: Program, mesh=None, *, layer=None, names=None,
     from ..core import monitor
     from ..core.flags import flag as _flag
 
-    axes = _mesh_axes(mesh)
+    axes, tiers = _mesh_topology(mesh)
+    # rebuild the device-free grammar form so every oracle pricing run
+    # carries the per-axis tiers (and the search stays Mesh-object-free)
+    mesh_desc = {ax: ({"size": n, **tiers[ax]} if ax in tiers else n)
+                 for ax, n in axes.items()} if tiers else dict(axes)
     coll_w = float(_flag("FLAGS_spmd_plan_coll_weight")
                    if coll_weight is None else coll_weight)
     hbm_w = float(_flag("FLAGS_spmd_plan_hbm_weight")
@@ -517,7 +601,7 @@ def plan_program(program: Program, mesh=None, *, layer=None, names=None,
 
     fixed_data = None if data_specs is None else \
         {k: _spec_entries(v) for k, v in data_specs.items()}
-    oracle = _Oracle(program, axes, coll_w, hbm_w)
+    oracle = _Oracle(program, axes, coll_w, hbm_w, mesh_desc=mesh_desc)
 
     repl_param = {s: ((),) * len(pv.aval.shape)
                   for s, pv in program.persistable_vars.items()}
@@ -541,7 +625,8 @@ def plan_program(program: Program, mesh=None, *, layer=None, names=None,
         n_d, best_score, _opt, best_rep = price(best_assign)
         base_score, base_rep = best_score, best_rep
     else:
-        groups = _build_groups(program, axes, names, zero_dp, fixed_data)
+        groups = _build_groups(program, axes, names, zero_dp, fixed_data,
+                               tiers=tiers)
         _, base_score, _opt, base_rep = price({})
 
         # beam over groups in dataflow order, STRATIFIED by diagnostic
@@ -638,15 +723,22 @@ def plan_program(program: Program, mesh=None, *, layer=None, names=None,
     if fixed_data is not None:
         data_plan = {k: _to_p(v) for k, v in fixed_data.items()}
 
+    predicted = {
+        "collective_bytes": best_rep.collective_bytes(),
+        "hbm_peak": best_rep.hbm["peak_bytes"] if best_rep.hbm else 0,
+        "diagnostics": len(best_rep.diagnostics),
+    }
+    if tiers:
+        predicted["weighted_collective_bytes"] = \
+            best_rep.weighted_collective_bytes()
+        predicted["tier_bytes"] = dict(sorted(
+            best_rep.tier_bytes().items()))
     plan = ShardingPlan(
         mesh_axes=dict(axes), param_specs=param_specs,
         data_specs=data_plan, rules=rules, names=names, report=best_rep,
         objective=float(best_score), evaluations=oracle.evaluations,
-        predicted={
-            "collective_bytes": best_rep.collective_bytes(),
-            "hbm_peak": best_rep.hbm["peak_bytes"] if best_rep.hbm else 0,
-            "diagnostics": len(best_rep.diagnostics),
-        },
+        mesh_tiers=dict(tiers), grad_sync=best_rep.hierarchical_sync(),
+        predicted=predicted,
         baseline={
             "collective_bytes": base_rep.collective_bytes(),
             "hbm_peak": base_rep.hbm["peak_bytes"] if base_rep.hbm else 0,
@@ -917,7 +1009,7 @@ def plan_pipeline(program: Program, mesh=None, *, axis="pp",
     from .shape_infer import analyze_memory
     from .spmd_analyzer import analyze_flops
 
-    axes = _mesh_axes(mesh)
+    axes, tiers = _mesh_topology(mesh)
     pp = int(axes.get(axis, 1))
     v = max(1, int(num_virtual))
     n_global = pp * v
@@ -940,7 +1032,8 @@ def plan_pipeline(program: Program, mesh=None, *, axis="pp",
     # dp/tp/sp layouts AND 'ep' expert placement ride the same search
     # (inner_beam/coll_weight/inner_hbm_weight tune that inner search;
     # `beam`/`hbm_weight` above are the STAGE-CUT search's knobs)
-    inner_axes = {a: s for a, s in axes.items() if a != axis}
+    inner_axes = {a: ({"size": s, **tiers[a]} if a in tiers else s)
+                  for a, s in axes.items() if a != axis}
     inner = plan_program(program, inner_axes, layer=layer, names=names,
                          data_specs=data_specs, zero_dp=zero_dp,
                          beam=inner_beam, coll_weight=coll_weight,
@@ -1068,7 +1161,7 @@ def plan_pipeline(program: Program, mesh=None, *, axis="pp",
         bub = bubble_fraction(M, pp, schedule, v)
         tick_b = frontier_tick_bytes(cut_vec)
         wire = schedule_collectives(M, pp, tick_b, schedule, v,
-                                    axis=axis)
+                                    axis=axis, tiers=tiers or None)
         obj = (fl_w * max_fl * M + bu_w * bub * total_flops
                + wi_w * wire["total_bytes"] + hb_w * max_hbm)
         return obj, bub, wire, tick_b

@@ -459,6 +459,10 @@ def test_collective_dtype_recorded_and_bytes_if():
         assert ar.bytes_if("int8") == ar.bytes // 4
         assert ar.bytes_if("float16") == ar.bytes // 2
         assert ar.bytes_if("float32") == ar.bytes
+        # fp8 wire dtypes live in ml_dtypes, not numpy proper — the
+        # EQuARX fp8 seam must price, not TypeError out of np.dtype
+        assert ar.bytes_if("float8_e4m3fn") == ar.bytes // 4
+        assert ar.bytes_if("float8_e5m2") == ar.bytes // 4
     finally:
         paddle.disable_static()
 
@@ -542,3 +546,96 @@ def test_add_tp_rule_accepts_callable_and_validates_rank():
             == P("tp", None)
     finally:
         assert sharding.remove_tp_rule(r"tiny\.bias$") == 1
+
+
+# ---------------------------------------------------------------------------
+# two-tier topology: per-tier pricing + the cross-tier diagnostic
+# ---------------------------------------------------------------------------
+
+TIERED_MESH = {"pod": {"size": 2, "tier": "dcn"}, "dp": 2, "tp": 2}
+
+
+def _tiered_gpt(batch=4):
+    from paddle_tpu.text.models.gpt import GPT, GPTConfig
+    main = static.Program("gpt_tiered")
+    with static.program_guard(main):
+        ids = static.data("input_ids", [batch, 16], "int64")
+        net = GPT(GPTConfig(vocab_size=1024, hidden_size=64,
+                            num_layers=2, num_heads=2,
+                            intermediate_size=128, max_seq_len=32))
+        logits = net(ids)
+    main._jit_fetch_vars = [logits]
+    return main, net, logits
+
+
+def test_tiered_mesh_prices_collectives_per_link(static_mode):
+    """Declaring link tiers adds tier/cost_us to every collective and a
+    per-tier wire-bytes rollup; the good layout (tp intra-pod, batch
+    DCN-major on (pod, dp)) carries ZERO diagnostics — the loss-free
+    pure-dp crossing is exempt from cross-tier by design."""
+    main, net, _ = _tiered_gpt()
+    specs = sharding.named_param_specs(net, TIERED_MESH)
+    rep = spmd.analyze_program(main, mesh=TIERED_MESH, param_specs=specs,
+                               data_specs={"input_ids": P(("pod", "dp"))})
+    assert rep.diagnostics == []
+    assert rep.mesh_tiers["pod"]["tier"] == "dcn"
+    assert rep.mesh_tiers["tp"]["tier"] == "ici"
+    ars = [c for c in rep.collectives if c.kind == "all_reduce"]
+    assert ars and all(c.tier == "ici" for c in ars)  # tp stays intra-pod
+    assert all(c.cost_us > 0 for c in ars)
+    tiers = rep.tier_bytes()
+    assert tiers.get("ici", 0) == sum(c.bytes for c in rep.collectives
+                                      if c.tier == "ici")
+    assert "link tiers: pod=dcn" in rep.render()
+
+
+def test_cross_tier_diagnostic_for_model_parallel_on_dcn(static_mode):
+    """A persistable sharded over the slow axis (model parallelism
+    crossing pods) raises cross-tier, naming op/var/axis; the same
+    layout on a flat mesh does not."""
+    main, net, _ = _tiered_gpt()
+    specs = sharding.named_param_specs(net, TIERED_MESH)
+    specs[net.wte.weight.scope_name] = P("pod", None)  # vocab over DCN
+    rep = spmd.analyze_program(main, mesh=TIERED_MESH, param_specs=specs,
+                               data_specs={"input_ids": P("dp")})
+    xt = [d for d in rep.diagnostics if d.code == "cross-tier"]
+    assert xt and xt[0].axis == "pod" and xt[0].var
+    assert "slow-tier" in xt[0].message
+    # flat mesh, same shapes: no tiers -> no cross-tier, identical render
+    flat = {"pod": 2, "dp": 2, "tp": 2}
+    rep2 = spmd.analyze_program(main, mesh=flat, param_specs=specs,
+                                data_specs={"input_ids": P("dp")})
+    assert rep2.mesh_tiers == {}
+    assert [d for d in rep2.diagnostics if d.code == "cross-tier"] == []
+    assert "link tiers" not in rep2.render()
+
+
+def test_hierarchical_sync_wire_model(static_mode):
+    """The dp gradient-sync pricing: hierarchical ships exactly 1/n of
+    the flat inter-pod bytes (n = intra-pod dp size); localsgd divides
+    the whole sync by k; the recommendation follows the cost ratio."""
+    main, net, _ = _tiered_gpt()
+    specs = sharding.named_param_specs(net, TIERED_MESH)
+    rep = spmd.analyze_program(main, mesh=TIERED_MESH, param_specs=specs,
+                               data_specs={"input_ids": P(("pod", "dp"))})
+    B = 4096
+    gs = rep.hierarchical_sync(grad_bytes=B)
+    assert gs["inner"] == {"axes": ["dp"], "size": 2}
+    assert gs["outer"] == {"axes": ["pod"], "size": 2}
+    ring = lambda b, s: int(2 * b * (s - 1) // s)  # noqa: E731
+    sch = gs["schemes"]
+    assert sch["flat"]["wire_bytes"] == {"ici": ring(B, 2),
+                                         "dcn": ring(B, 2)}
+    assert sch["hierarchical"]["wire_bytes"] == {"ici": ring(B, 2),
+                                                 "dcn": ring(B // 2, 2)}
+    assert sch["localsgd"]["wire_bytes"]["dcn"] == ring(B, 2) // 4
+    assert gs["inter_pod_reduction_x"] == 2.0
+    assert gs["recommendation"] == "hierarchical"
+    # per-step DCN cost dominates ICI by the bandwidth gap / shard ratio
+    assert sch["flat"]["cost_us"]["dcn"] > sch["flat"]["cost_us"]["ici"]
+    # flat mesh: nothing to decompose
+    rep2 = spmd.analyze_program(main, mesh={"dp": 2, "tp": 2},
+                                param_specs=sharding.named_param_specs(
+                                    net, {"dp": 2, "tp": 2}),
+                                data_specs={"input_ids": P("dp")})
+    assert rep2.hierarchical_sync() is None

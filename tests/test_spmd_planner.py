@@ -397,3 +397,148 @@ def test_template_collision_with_replicated_group_keeps_exact_rules(
     # any sharded sibling uses an exact-name rule only
     for r in plan.rules:
         assert r"\d+" not in r.template
+
+
+# ---------------------------------------------------------------------------
+# two-tier topology: the planner keeps tp intra-pod from cost alone
+# ---------------------------------------------------------------------------
+
+TIERED_MESH = {"pod": {"size": 2, "tier": "dcn"}, "dp": 2, "tp": 2}
+
+
+def test_topology_plan_pins_tp_intra_pod(static_mode):
+    """On the {pod(dcn), dp, tp} mesh the beam must land the Megatron
+    layout with every model-parallel collective on the fast tier and
+    the batch DCN-major — zero diagnostics, zero cross-tier — and carry
+    the hierarchical grad-sync selection into the fleet strategy."""
+    cfg = GPTConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                    num_heads=2, intermediate_size=64, max_seq_len=16)
+    main = static.Program("topo_gpt")
+    with static.program_guard(main):
+        ids = static.data("input_ids", [8, 16], "int64")
+        net = GPT(cfg)
+        net.eval()
+        _ = net(ids)
+    plan = plan_program(main, TIERED_MESH, layer=net)
+    assert plan.predicted["diagnostics"] == 0
+    assert not [d for d in plan.report.diagnostics
+                if d.code == "cross-tier"]
+    # nothing but the (exempt) data feed may touch the slow axis
+    for c in plan.report.collectives:
+        assert "pod" not in str(c.axis).split(",")
+    spec = plan.data_specs["input_ids"]
+    assert tuple(spec)[0] == ("pod", "dp")  # DCN-major batch
+    assert plan.mesh_tiers["pod"]["tier"] == "dcn"
+    gs = plan.grad_sync
+    assert gs["recommendation"] == "hierarchical"
+    assert gs["inter_pod_reduction_x"] >= 2.0
+    strat = plan.as_strategy()
+    assert strat.hierarchical_allreduce is True
+    assert strat.hierarchical_allreduce_configs == {
+        "inner_axes": ["dp"], "outer_axes": ["pod"]}
+    # the topology block serializes; flat plans stay byte-identical
+    assert "topology" in plan.to_json()
+    flat = plan_program(main, {"tp": 2}, layer=net)
+    assert "topology" not in flat.to_json()
+    assert flat.as_strategy().hierarchical_allreduce is False
+
+
+def test_cli_topology_json_stable(capsys):
+    _tools()
+    import spmd_plan
+    assert spmd_plan.main(["--topology", "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True and payload["cross_tier"] == 0
+    gs = payload["topology"]["grad_sync"]
+    assert gs["recommendation"] == "hierarchical"
+    sch = gs["schemes"]
+    assert sch["hierarchical"]["wire_bytes"]["dcn"] * 2 \
+        == sch["flat"]["wire_bytes"]["dcn"]
+    assert sch["hierarchical"]["wire_bytes"]["ici"] \
+        == sch["flat"]["wire_bytes"]["ici"]
+    assert spmd_plan.main(["--topology", "--json"]) == 0
+    assert json.loads(capsys.readouterr().out) == payload
+
+
+def test_topology_plan_matches_flat_plan_loss(static_mode):
+    """The nested-mesh acceptance: one GPT train step jitted over the
+    8-device {pod: 2, dp: 2, tp: 2} mesh with the topology plan's
+    shardings lands on the same loss and updated params as the flat
+    {dp: 4, tp: 2} plan — the pod split of the batch is a relabeling
+    of dp, so the two-tier layout costs nothing in arithmetic."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+
+    cfg = GPTConfig(vocab_size=256, hidden_size=32, num_layers=2,
+                    num_heads=2, intermediate_size=64, max_seq_len=16)
+    main = static.Program("topo_e2e")
+    with static.program_guard(main):
+        ids_v = static.data("input_ids", [4, 16], "int64")
+        net = GPT(cfg)
+        net.eval()
+        _ = net(ids_v)
+    plan_topo = plan_program(main, TIERED_MESH, layer=net)
+    plan_flat = plan_program(main, {"dp": 4, "tp": 2}, layer=net)
+    assert plan_topo.predicted["diagnostics"] == 0
+    assert plan_flat.predicted["diagnostics"] == 0
+    paddle.disable_static()
+
+    from paddle_tpu.core import rng as _rng
+    from paddle_tpu.core import tape as _tape
+    from paddle_tpu.core.tensor import Tensor
+
+    paddle.seed(0)
+    net2 = GPT(cfg)
+    net2.eval()
+    params, buffers = net2.functional_state()
+
+    def loss_and_update(p, ids, labels):
+        with _rng.rng_state(jax.random.PRNGKey(0)), _tape.no_grad():
+            def loss_of(pp):
+                net2.load_functional_state(pp, buffers)
+                loss = net2(Tensor(ids, _internal=True),
+                            labels=Tensor(labels, _internal=True))
+                return loss._value
+            loss, grads = jax.value_and_grad(loss_of)(p)
+            new_p = jax.tree_util.tree_map(
+                lambda w, g: w - 0.1 * g, p, grads)
+        return loss, new_p
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(4, cfg.vocab_size, (4, 16)), jnp.int64)
+    labels = jnp.asarray(rng.randint(4, cfg.vocab_size, (4, 16)),
+                         jnp.int64)
+
+    def run(plan, mesh_shape, name):
+        mesh = mesh_mod.init_mesh(mesh_shape, name=name,
+                                  devices=jax.devices()[:8])
+        try:
+            repl = NamedSharding(mesh, P())
+            data_sh = NamedSharding(mesh,
+                                    plan.data_specs["input_ids"])
+            shardings = plan.build_param_shardings(params, mesh)
+            assert any(tuple(s.spec) and any(tuple(s.spec))
+                       for s in shardings.values())
+            step = jax.jit(loss_and_update,
+                           in_shardings=(shardings, data_sh, data_sh),
+                           out_shardings=(repl, shardings))
+            with mesh:
+                loss, new_p = step(params, ids, labels)
+            return float(np.asarray(loss)), new_p
+        finally:
+            mesh_mod.reset_mesh(name)
+
+    assert tuple(plan_topo.data_specs["input_ids"])[0] == ("pod", "dp")
+    loss_t, p_t = run(plan_topo, TIERED_MESH, "_topo_e2e")
+    loss_f, p_f = run(plan_flat, {"dp": 4, "tp": 2}, "_flat_e2e")
+    assert np.isfinite(loss_t)
+    np.testing.assert_allclose(loss_t, loss_f, rtol=1e-5)
+    for k in ("wte.weight", "blocks.0.attn.qkv_proj.weight",
+              "blocks.1.fc2.weight"):
+        np.testing.assert_allclose(np.asarray(p_t[k]),
+                                   np.asarray(p_f[k]), rtol=1e-5,
+                                   atol=1e-6)

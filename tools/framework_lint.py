@@ -411,6 +411,9 @@ PERF_FLOORS = [
     ("scan-fused dispatch reduction",
      ("graphs", "pipeline_scan_megastep", "dispatch_model",
       "dispatch_reduction_x"), 2.0),
+    ("hierarchical dp sync inter-pod wire-bytes reduction",
+     ("graphs", "hierarchical_sync", "wire_model",
+      "inter_pod_reduction_x"), 2.0),
 ]
 
 

@@ -646,6 +646,46 @@ def run(out_path="HLO_EVIDENCE.json", tiny=False):
               f"{info['while_ops']} while op(s)")
         check("dispatches per K steps reduced >= 2x (default bench cfg)",
               k_full >= 2, f"{k_full}x")
+
+        # ---- two-tier topology: hierarchical dp gradient sync ---------
+        # analytic wire model (SpmdReport.hierarchical_sync over the
+        # spmd_plan topology golden: outer 'pod' axis on the slow DCN
+        # tier, inner 'dp' on ICI). Pure ring arithmetic over the planned
+        # layout's gradient bytes — no lowering involved, so the DEFAULT
+        # golden prices even in --tiny.
+        if TOOLS_DIR not in sys.path:
+            sys.path.insert(0, TOOLS_DIR)
+        import importlib
+        spmd_plan = importlib.import_module("spmd_plan")
+        tplan, _, _ = spmd_plan.build_topology_plan()
+        gs = dict(tplan.grad_sync or {})
+        gs["model"] = (
+            "per-device ring all-reduce of B grad bytes over s devices "
+            "moves 2*B*(s-1)/s; flat crosses DCN with the full B while "
+            "hierarchical reduce-scatters intra-pod first and ships only "
+            "the B/n shard inter-pod (localsgd divides the whole sync "
+            "by k steps); cost_us = bytes / (link_gbps * 1e3)")
+        report["graphs"]["hierarchical_sync"] = {
+            "config": {
+                "mesh": {ax: ({"size": n, **tplan.mesh_tiers[ax]}
+                              if ax in tplan.mesh_tiers else n)
+                         for ax, n in tplan.mesh_axes.items()},
+                "workload": "spmd_plan topology golden GPT "
+                            "(build_topology_plan defaults)",
+            },
+            "wire_model": gs,
+        }
+        n_xtier = sum(d.code == "cross-tier"
+                      for d in tplan.report.diagnostics)
+        check("topology-planned golden keeps model parallelism "
+              "intra-pod (zero cross-tier diagnostics)",
+              n_xtier == 0 and not tplan.report.diagnostics,
+              f"{len(tplan.report.diagnostics)} diagnostic(s), "
+              f"{n_xtier} cross-tier")
+        check("hierarchical dp sync cuts inter-pod wire bytes >= 2x "
+              "vs flat", gs.get("inter_pod_reduction_x", 0.0) >= 2.0,
+              f"{gs.get('inter_pod_reduction_x')}x, recommendation="
+              f"{gs.get('recommendation')}")
     finally:
         paddle.set_flags({k: v for k, v in saved.items()})
 

@@ -26,7 +26,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 INJECTIONS = ("unbound-axis", "non-divisible", "duplicate-axis",
-              "spec-rank")
+              "spec-rank", "cross-tier")
 
 
 def build_gpt_program(layers=2, hidden=64, heads=2, vocab=1024, batch=2,
@@ -95,7 +95,14 @@ def build_report(tp=2, dp=1, layers=2, hidden=64, heads=2, vocab=1024,
             "duplicate-axis": P("tp", "tp"),
             "non-divisible": None,  # handled below via odd vocab
             "spec-rank": P("tp", None, "tp"),
+            # a persistable sharded over the slow DCN axis: the embedding
+            # gather's all-reduce then rides the inter-pod link every
+            # step — the layout mistake the topology cost model exists
+            # to catch (model parallelism must stay intra-pod)
+            "cross-tier": P("pod", None),
         }[inject]
+        if inject == "cross-tier":
+            mesh["pod"] = {"size": 2, "tier": "dcn"}
         if inject == "non-divisible":
             # a vocab the tp axis cannot divide — swapped in as a view
             # on a CLONED program; the real Variable keeps its aval

@@ -11,13 +11,25 @@ diagnostics or loses to the preset on either predicted metric.
   python tools/spmd_plan.py --tp 4 --dp 2 --layers 12 --hidden 768
   python tools/spmd_plan.py --tp 2 --dp 2 --sp 2   # hybrid mesh
   python tools/spmd_plan.py --json           # stable output for CI
+  python tools/spmd_plan.py --topology --pods 2 --dp 2 --tp 2
+                                             # two-tier wire-cost report
+
+`--topology` plans the same GPT on a nested two-tier mesh (a `pod` axis
+on the slow DCN tier over the ICI axes) and renders the per-tier
+wire-bytes table: flat dp all-reduce vs the hierarchical decomposition
+(reduce-scatter intra-pod -> inter-pod all-reduce of the 1/n shard ->
+all-gather) vs LocalSGD. Exit 1 if the planner leaves tp/sp crossing
+the slow tier (any `cross-tier` diagnostic) or the hierarchical scheme
+fails to cut inter-pod bytes by >= 2x.
 
 `self_check()` (registered in tools/framework_lint.py TOOL_CROSS_CHECKS
 and run by tests/test_spmd_planner.py in tier-1) pins the golden
 rediscovery: on a tp-only mesh the search must reproduce the Megatron
 layout (qkv/fc1 column-parallel, out-proj/fc2 row-parallel, wte
 vocab-parallel) with zero diagnostics at preset-or-better predicted
-cost, and a dp×tp mesh must shard the `input_ids` feed on dp.
+cost, a dp×tp mesh must shard the `input_ids` feed on dp, and the
+two-tier `{pod:2, dp:2, tp:2}` mesh must keep tp intra-pod with the
+hierarchical dp sync recommended.
 """
 from __future__ import annotations
 
@@ -68,6 +80,80 @@ def _metrics(report):
     return {"collective_bytes": report.collective_bytes(),
             "hbm_peak": report.hbm["peak_bytes"] if report.hbm else 0,
             "diagnostics": len(report.diagnostics)}
+
+
+def build_topology_plan(pods=2, dp=2, tp=2, sp=1, layers=2, hidden=64,
+                        heads=2, vocab=1024, batch=8, seq=16, beam=None,
+                        coll_weight=None, hbm_weight=None):
+    """Plan the GPT workload on a nested two-tier mesh: a `pod` axis on
+    the slow DCN tier over the usual ICI axes. Returns (plan, program,
+    net); the plan carries `mesh_tiers`/`grad_sync` (the priced
+    flat/hierarchical/localsgd dp sync schemes)."""
+    from paddle_tpu.static import spmd_planner
+    from spmd_lint import build_gpt_program
+
+    mesh = {"pod": {"size": pods, "tier": "dcn"}}
+    if dp > 1:
+        mesh["dp"] = dp
+    if tp > 1:
+        mesh["tp"] = tp
+    if sp > 1:
+        mesh["sp"] = sp
+    program, net, _logits = build_gpt_program(
+        layers=layers, hidden=hidden, heads=heads, vocab=vocab,
+        batch=batch, seq=seq, name="spmd_plan_topo_gpt")
+    plan = spmd_planner.plan_program(
+        program, mesh, layer=net, beam=beam, coll_weight=coll_weight,
+        hbm_weight=hbm_weight)
+    return plan, program, net
+
+
+def topology_json(plan) -> dict:
+    """Stable JSON for CI: the plan (with its `topology` block) + the
+    acceptance verdict — zero diagnostics (so no tp/sp collective
+    crosses the slow tier), a dp sync priced hierarchically, and the
+    hierarchical scheme cutting inter-pod wire bytes >= 2x vs flat."""
+    out = plan.to_json()
+    rep = plan.report
+    out["cross_tier"] = sum(1 for d in (rep.diagnostics if rep else [])
+                            if d.code == "cross-tier")
+    gs = plan.grad_sync or {}
+    hier_2x = False
+    if gs:
+        flat_dcn = gs["schemes"]["flat"]["wire_bytes"]["dcn"]
+        hier_dcn = gs["schemes"]["hierarchical"]["wire_bytes"]["dcn"]
+        hier_2x = hier_dcn * 2 <= flat_dcn
+    out["ok"] = bool(
+        out["predicted"]["diagnostics"] == 0
+        and out["cross_tier"] == 0
+        and gs and hier_2x
+        and gs.get("recommendation") in ("hierarchical", "localsgd"))
+    return out
+
+
+def render_topology(plan) -> str:
+    lines = [plan.render()]
+    tb = plan.predicted.get("tier_bytes") or {}
+    if tb:
+        lines.append("step collectives per tier: " + ", ".join(
+            f"{t}={b} B" for t, b in sorted(tb.items())))
+    gs = plan.grad_sync
+    if not gs:
+        lines.append("dp gradient sync: n/a (no pure-dp axis)")
+        return "\n".join(lines)
+    lines.append("per-tier wire bytes (dp gradient sync, per device):")
+    lines.append(f"  {'scheme':<14}{'ici B':>14}{'dcn B':>14}"
+                 f"{'cost us':>12}")
+    for name in ("flat", "hierarchical", "localsgd"):
+        s = gs["schemes"][name]
+        lines.append(f"  {name:<14}{s['wire_bytes']['ici']:>14}"
+                     f"{s['wire_bytes']['dcn']:>14}"
+                     f"{s['total_cost_us']:>12.1f}")
+    lines.append(
+        f"recommendation: {gs['recommendation']} (hierarchical cuts "
+        f"inter-pod bytes {gs['inter_pod_reduction_x']:.1f}x, localsgd "
+        f"amortizes 1/{gs['localsgd_k']})")
+    return "\n".join(lines)
 
 
 def build_moe_program(layers=4, hidden=64, experts=4, d_hidden=None,
@@ -251,6 +337,37 @@ def self_check():
         problems.append(
             f"spmd_plan pipeline golden {{pp:4}}: {len(pplan.stages)} "
             "stages planned, expected 4")
+    # the topology golden: {pod:2(dcn), dp:2, tp:2} must keep tp
+    # intra-pod from cost alone (zero cross-tier diagnostics), shard the
+    # batch over (pod, dp), and price the hierarchical dp sync at >= 2x
+    # less inter-pod wire than the flat all-reduce
+    try:
+        tplan, _tprog, _tnet = build_topology_plan(pods=2, dp=2, tp=2,
+                                                   batch=8)
+    except Exception as e:  # noqa: BLE001
+        return problems + [f"spmd_plan --topology self-check crashed: "
+                           f"{e!r}"]
+    tpayload = topology_json(tplan)
+    if not tpayload["ok"]:
+        problems.append(
+            "spmd_plan topology golden {pod:2,dp:2,tp:2}: plan not ok — "
+            f"diagnostics {tpayload['predicted']['diagnostics']}, "
+            f"cross-tier {tpayload['cross_tier']}, grad_sync "
+            f"{tplan.grad_sync and tplan.grad_sync.get('recommendation')}")
+    gs = tplan.grad_sync or {}
+    if gs.get("recommendation") != "hierarchical":
+        problems.append(
+            "spmd_plan topology golden: expected the hierarchical dp "
+            f"sync recommendation, got {gs.get('recommendation')!r}")
+    if float(gs.get("inter_pod_reduction_x", 0)) < 2.0:
+        problems.append(
+            "spmd_plan topology golden: hierarchical sync cuts inter-pod "
+            f"bytes only {gs.get('inter_pod_reduction_x')}x, need >= 2x")
+    tids = tuple(tplan.data_specs.get("input_ids", P()))
+    if not tids or tids[0] != ("pod", "dp"):
+        problems.append(
+            "spmd_plan topology golden: input_ids batch dim not sharded "
+            f"over (pod, dp) (got {tids})")
     return problems
 
 
@@ -284,6 +401,13 @@ def main(argv=None):
                     help="plan pipeline stage cuts (and MoE expert "
                          "placement with --ep) instead of a single-SPMD "
                          "layout; --pp sets the stage count")
+    ap.add_argument("--topology", action="store_true",
+                    help="plan on a nested two-tier mesh (--pods on the "
+                         "slow DCN tier over the ICI axes) and render "
+                         "the per-tier wire-bytes table: flat vs "
+                         "hierarchical vs localsgd dp sync")
+    ap.add_argument("--pods", type=int, default=2,
+                    help="slow-tier (DCN) pod count (--topology mode)")
     ap.add_argument("--pp", type=int, default=4,
                     help="pipeline stages (--pipeline mode)")
     ap.add_argument("--ep", type=int, default=1,
@@ -309,6 +433,24 @@ def main(argv=None):
             print(f"search: {plan.evaluations} stage evaluations, "
                   f"{plan.inner.evaluations if plan.inner else 0} "
                   "layout evaluations")
+        return 0 if payload["ok"] else 1
+
+    if args.topology:
+        dp = args.dp if args.dp > 1 else 2
+        batch = args.batch if args.batch % (args.pods * dp) == 0 \
+            else 2 * args.pods * dp
+        plan, _prog, _net = build_topology_plan(
+            pods=args.pods, dp=dp, tp=args.tp, sp=args.sp,
+            layers=2 if args.layers is None else args.layers,
+            hidden=args.hidden, heads=args.heads, vocab=args.vocab,
+            batch=batch, seq=args.seq, beam=args.beam,
+            coll_weight=args.coll_weight, hbm_weight=args.hbm_weight)
+        payload = topology_json(plan)
+        if args.json:
+            print(json.dumps(payload, sort_keys=True, indent=1))
+        else:
+            print(render_topology(plan))
+            print(f"search: {plan.evaluations} analyzer evaluations")
         return 0 if payload["ok"] else 1
 
     plan, preset, replicated, _prog, _net, _logits = build_plan(
