@@ -2,9 +2,9 @@
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 Modes (BENCH_MODE env): "all" (default) = bert + resnet + decode +
-longseq + pipeline + serve + sparse + online; or a single one of "bert"
-/ "resnet" / "decode" / "longseq" / "pipeline" / "serve" / "sparse" /
-"online".
+longseq + pipeline + serve + sparse + online + traffic; or a single one
+of "bert" / "resnet" / "decode" / "longseq" / "pipeline" / "serve" /
+"sparse" / "online" / "traffic".
 - bert   — flagship: BERT-base MLM training (BASELINE config 3). The
   FIRST stdout line; vs_baseline = measured MFU / 0.40 (the BASELINE.md
   north-star; the reference publishes no numbers of its own).
@@ -27,6 +27,11 @@ longseq + pipeline + serve + sparse + online; or a single one of "bert"
   replay-keyed delta flushes -> EmbeddingSnapshotPublisher versioned
   cuts (docs/online_learning.md). Valid on CPU too: host machinery plus
   a tiny jitted step.
+- traffic — the traffic-lab closed loop: a seeded deterministic workload
+  schedule (paddle_tpu/traffic/workload.py) paced at the tiny-GPT
+  ServeLoop through the shared harness, reporting completed req/s and
+  hub-comparable TTFT/token p50/p99 (docs/traffic_lab.md). Valid on CPU
+  too: scheduler + paged pool + paced arrivals are host machinery.
 
 Peak bf16 flops per v5e chip: 197 TFLOP/s (v5e spec sheet figure).
 
@@ -967,6 +972,53 @@ def bench_online():
     }), flush=True)
 
 
+def bench_traffic():
+    """Traffic-lab closed loop (BENCH_MODE=traffic): replay a seeded
+    Poisson workload (paddle_tpu/traffic/workload.py) through the shared
+    harness (traffic/harness.py run_spec) over the tiny-GPT ServeLoop
+    and report completed requests/s plus the hub-comparable p50/p99
+    TTFT/token latencies. Scheduler + paged pool + paced arrivals are
+    host/dispatch machinery, so the numbers are real on CPU and the
+    mode rides the tunnel-down degrade path; knobs are pinned by
+    tools/capacity_plan.py's self_check."""
+    from paddle_tpu.traffic import harness, workload
+
+    requests = int(os.environ.get("BENCH_TRAFFIC_REQUESTS", 96))
+    rate = int(os.environ.get("BENCH_TRAFFIC_RATE", 40))
+    new = int(os.environ.get("BENCH_TRAFFIC_NEW", 8))
+    clients = int(os.environ.get("BENCH_TRAFFIC_CLIENTS", 4))
+
+    spec = workload.WorkloadSpec(
+        name="bench-traffic",
+        arrival={"kind": "poisson", "rate": float(rate)},
+        duration_s=requests / float(rate),
+        tenants=({"name": "bench", "weight": 1.0, "kind": "llm",
+                  "prompt": {"kind": "lognormal", "median": 8,
+                             "sigma": 0.5, "lo": 2},
+                  "new": {"kind": "fixed", "value": new}},),
+        vocab=1024, max_seq_len=48)
+    rep = harness.run_spec(spec, seed=0, clients=clients)
+    print(json.dumps({
+        "metric": f"traffic_closed_loop_r{rate}",
+        "value": rep.throughput_rps,
+        "unit": "requests/sec served",
+        "vs_baseline": 1.0,
+        "traffic": {
+            "events": rep.events,
+            "completed": rep.completed,
+            "errors": rep.errors,
+            "offered_rps": rep.offered_rps,
+            "tokens_per_s": rep.tokens_per_s,
+            "ttft_ms": rep.ttft_ms,
+            "token_ms": rep.token_ms,
+            "backpressure_waits": rep.backpressure_waits,
+            "preempted": rep.preempted,
+            "schedule_digest": rep.schedule_digest[:16],
+            "scored_by": rep.scored_by,
+        },
+    }), flush=True)
+
+
 def _probe_backend(timeout_s):
     """Detect a wedged TPU tunnel (init can hang forever on a stale pool
     lease): probe jax.devices() in a thread. Returns True when the
@@ -1076,6 +1128,14 @@ def _degraded_evidence_bench():
     except Exception as e:
         print(f"# online bench failed: {type(e).__name__}: {e}",
               file=sys.stderr, flush=True)
+    # the traffic-lab closed loop paces a seeded schedule at the serve
+    # scheduler — host machinery end to end, truthful without a TPU
+    try:
+        bench_traffic()
+        _emit_metrics_snapshot("traffic")
+    except Exception as e:
+        print(f"# traffic bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
     return 0 if report.get("graphs") else 3
 
 
@@ -1175,6 +1235,13 @@ def main():
             _emit_metrics_snapshot("online")
         except Exception as e:  # additive evidence line, never blocking
             print(f"# online bench failed: {type(e).__name__}: {e}",
+                  file=sys.stderr, flush=True)
+    if mode in ("traffic", "all"):
+        try:
+            bench_traffic()
+            _emit_metrics_snapshot("traffic")
+        except Exception as e:  # additive evidence line, never blocking
+            print(f"# traffic bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr, flush=True)
 
 

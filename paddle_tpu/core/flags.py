@@ -130,6 +130,36 @@ define_flag("FLAGS_topology_localsgd_ratio", 8.0,
             "sync's inter-pod cost_us exceeds its intra-pod cost_us by "
             "this factor, the topology report recommends the LocalSGD "
             "degraded mode instead (accuracy-for-bandwidth trade)")
+define_flag("PADDLE_TRAFFIC_SEED", 0,
+            "base seed for the traffic lab's named splitmix64 draw "
+            "streams (traffic/workload.py); two runs of the same spec "
+            "with the same seed are byte-identical — schedule AND "
+            "per-request token draws")
+define_flag("PADDLE_TRAFFIC_TIME_SCALE", 1.0,
+            "wall-clock multiplier the harness paces a workload "
+            "schedule with (traffic/harness.run_spec): 1.0 replays the "
+            "spec in real time, 0.5 compresses it 2x (stress), 2.0 "
+            "stretches it (debug)")
+define_flag("PADDLE_TRAFFIC_CLIENTS", 4,
+            "number of submitter threads the traffic harness partitions "
+            "a schedule across (round-robin by event index)")
+define_flag("FLAGS_capacity_p50_band_pct", 25.0,
+            "capacity_plan --validate error band: hub-observed "
+            "throughput and TTFT/token p50 must land within this "
+            "percentage of the model's prediction")
+define_flag("FLAGS_capacity_p99_band_pct", 40.0,
+            "capacity_plan --validate error band for the tail: "
+            "hub-observed TTFT/token p99 within this percentage of "
+            "prediction (tails carry sampling noise the p50 band "
+            "doesn't)")
+define_flag("FLAGS_capacity_knee_rho", 0.85,
+            "utilization the capacity report flags as the saturation "
+            "knee: offered loads driving predicted slot utilization "
+            "above this are marked over-knee (queueing delay diverges)")
+define_flag("FLAGS_capacity_calib_beats", 32,
+            "decode beats the CPU calibration measures per active-level "
+            "when fitting the device profile's beat_ms base/slope "
+            "(static/capacity.calibrate)")
 define_flag("FLAGS_use_flash_attention", True,
             "route attention through the Pallas flash kernel on TPU "
             "(paddle_tpu.ops.pallas.flash_attention)")

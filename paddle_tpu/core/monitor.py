@@ -41,9 +41,9 @@ import time
 from collections import defaultdict, deque
 
 __all__ = ["stat_add", "stat_set", "stat_set_many", "stat_get", "stats",
-           "reset", "observe", "counter", "gauge", "histogram", "series",
-           "histogram_summary", "snapshot", "export_jsonl",
-           "prometheus_text", "DEFAULT_BUCKETS",
+           "reset", "observe", "ensure_hist", "counter", "gauge",
+           "histogram", "series", "histogram_summary", "snapshot",
+           "export_jsonl", "prometheus_text", "DEFAULT_BUCKETS",
            "Counter", "Gauge", "Histogram"]
 
 _lock = threading.Lock()
@@ -140,6 +140,18 @@ def observe(name: str, value, buckets=None):
             _types.setdefault(name, "histogram")
         h.observe(value)
         _sample_locked(name, value)
+
+
+def ensure_hist(name: str, buckets):
+    """Pre-register a histogram with explicit bucket bounds. A histogram's
+    bounds are fixed by whoever observes it first; latency consumers that
+    need finer resolution than DEFAULT_BUCKETS (the traffic harness scores
+    serve/ttft_ms against a ±25% error band) register theirs up front,
+    before the serving path's first `observe` wins with the defaults."""
+    with _lock:
+        if name not in _hists:
+            _hists[name] = _Hist(buckets)
+            _types.setdefault(name, "histogram")
 
 
 # -- readers -----------------------------------------------------------------

@@ -72,7 +72,8 @@ GAUGES = ("serve.queue_depth", "serve.active_slots",
           "serve.model_version")
 COUNTERS = ("serve.preempted", "serve.tokens_generated",
             "serve.requests_completed", "serve.requests_errored",
-            "serve.hot_swaps", "serve.completion_log_errors")
+            "serve.hot_swaps", "serve.completion_log_errors",
+            "serve.backpressure_waits")
 
 _REQ_IDS = itertools.count()
 
@@ -533,6 +534,7 @@ class ServeLoop:
         return None
 
     def _admit(self):
+        from ..core import monitor
         from ..core import trace as _trace
         while True:
             with self._lock:
@@ -541,6 +543,7 @@ class ServeLoop:
                 return
             idx = self._free_slot()
             if idx is None:
+                monitor.stat_add("serve.backpressure_waits")
                 return
             prompt = np.concatenate(
                 [req.prompt, np.asarray(req.out, np.int64)]) \
@@ -551,6 +554,7 @@ class ServeLoop:
             # starvation of long requests) until retiring streams free
             # enough blocks for its whole worst case
             if not self._pool.can_alloc(need_total):
+                monitor.stat_add("serve.backpressure_waits")
                 return
             with self._lock:
                 self._queue.popleft()

@@ -1,0 +1,15 @@
+"""Traffic lab: deterministic workload generation + the shared load
+harness (docs/traffic_lab.md). The capacity model that predicts what
+these workloads will observe lives in static/capacity.py."""
+from .harness import (HarnessReport, PoolRun, ServeStats, Submission,
+                      Window, drive_serve, run_spec, run_worker_pool,
+                      submissions_from_events, submissions_from_prompts)
+from .workload import (BUILTIN_SPECS, Event, Stream, WorkloadGenerator,
+                       WorkloadSpec, builtin_spec, schedule,
+                       schedule_digest)
+
+__all__ = ["Stream", "WorkloadSpec", "WorkloadGenerator", "Event",
+           "schedule", "schedule_digest", "builtin_spec", "BUILTIN_SPECS",
+           "Submission", "ServeStats", "drive_serve", "run_worker_pool",
+           "PoolRun", "Window", "run_spec", "HarnessReport",
+           "submissions_from_prompts", "submissions_from_events"]

@@ -1,0 +1,156 @@
+"""Analytic capacity model (ISSUE 18): the deterministic beat
+simulation, the queueing closed forms, and the profile round-trip. The
+full closed-loop `--validate` (CPU calibration + live harness replay)
+is @slow — tier-1 asserts the model's math, the committed
+HLO_EVIDENCE.json record, and determinism."""
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from paddle_tpu.static import capacity as C  # noqa: E402
+from paddle_tpu.traffic import workload as W  # noqa: E402
+
+
+def _profile(**kw):
+    d = dict(source="test", beat_ms_base=2.0, beat_ms_per_active=0.5,
+             prefill_ms={8: 4.0, 16: 7.0}, admit_ms=1.0,
+             admit_serial_ms=0.5, ttft_tail_ms=3.0, token_tail_ms=0.2)
+    d.update(kw)
+    return C.DeviceProfile(**d)
+
+
+def _spec(rate=40.0, duration_s=2.0, new=6, prompt=6):
+    return W.WorkloadSpec(
+        name="cap", duration_s=duration_s,
+        arrival={"kind": "poisson", "rate": rate},
+        tenants=({"name": "t", "weight": 1.0, "kind": "llm",
+                  "prompt": {"kind": "fixed", "value": prompt},
+                  "new": {"kind": "fixed", "value": new}},),
+        max_seq_len=48)
+
+
+def test_bucket_mirrors_the_serve_pad_ladder():
+    assert [C._bucket(n) for n in (1, 8, 9, 16, 17, 33)] == \
+        [8, 8, 16, 16, 32, 64]
+
+
+def test_device_profile_round_trips_and_extrapolates():
+    p = _profile()
+    q = C.DeviceProfile.from_dict(json.loads(json.dumps(p.as_dict())))
+    assert q.as_dict() == p.as_dict()
+    # affine beat model
+    assert p.beat_ms(4) == pytest.approx(2.0 + 0.5 * 4)
+    assert p.beat_ms(0) == pytest.approx(2.0)
+    # known bucket exact, unknown bucket extrapolated linearly in width
+    assert p.prefill_cost_ms(7) == pytest.approx(4.0)
+    assert p.prefill_cost_ms(30) == pytest.approx(7.0 * 32 / 16)
+
+
+def test_queueing_closed_forms():
+    # Erlang C: certain wait at/over saturation, monotone in load
+    assert C._erlang_c(10.0, 1.0, 10) == 1.0
+    lo = C._erlang_c(2.0, 1.0, 8)
+    hi = C._erlang_c(6.0, 1.0, 8)
+    assert 0.0 < lo < hi < 1.0
+    # Allen-Cunneen wait: zero without load, inf past saturation,
+    # monotone in offered rate and in service-time variability
+    assert C.queue_wait_ms(0.0, 0.1, 1.0, 4) == 0.0
+    assert C.queue_wait_ms(50.0, 0.1, 1.0, 4) == float("inf")
+    w1 = C.queue_wait_ms(20.0, 0.1, 1.0, 4)
+    w2 = C.queue_wait_ms(30.0, 0.1, 1.0, 4)
+    assert 0.0 < w1 < w2
+    assert C.queue_wait_ms(20.0, 0.1, 3.0, 4) > w1
+
+
+def test_knee_shrinks_with_longer_generations():
+    p = _profile()
+    k_short = C.knee_rps(p, slots=8, mean_new=4.0, mean_prompt=8.0)
+    k_long = C.knee_rps(p, slots=8, mean_new=16.0, mean_prompt=8.0)
+    assert k_long < k_short
+    # more slots buy capacity while prefill stays off the beat
+    assert C.knee_rps(_profile(beat_ms_per_active=0.0, prefill_ms={8: 0.1},
+                               admit_serial_ms=0.0),
+                      slots=16, mean_new=4.0, mean_prompt=8.0) > \
+        C.knee_rps(_profile(beat_ms_per_active=0.0, prefill_ms={8: 0.1},
+                            admit_serial_ms=0.0),
+                   slots=8, mean_new=4.0, mean_prompt=8.0)
+
+
+def test_simulate_is_deterministic_and_complete():
+    events = W.schedule(_spec(), seed=11)
+    assert events
+    kw = dict(slots=4, kv_blocks=24, block_size=8)
+    a = C.simulate(events, _profile(), **kw)
+    b = C.simulate(events, _profile(), **kw)
+    assert a == b
+    assert a["completed"] == len(events)
+    assert len(a["ttfts_ms"]) == len(events)
+    # every TTFT carries the admission latency floor
+    assert min(a["ttfts_ms"]) >= 1.0
+
+
+def test_simulate_backpressure_and_preemption_paths():
+    # a pool of 2 blocks against 2-block worst cases: admissions stall
+    events = W.schedule(_spec(rate=80.0, duration_s=1.0), seed=3)
+    tight = C.simulate(events, _profile(), slots=8, kv_blocks=2,
+                       block_size=8)
+    assert tight["completed"] == len(events)      # stalls, never drops
+    assert tight["backpressure_ticks"] > 0
+    # growth into an exhausted pool preempts and still completes
+    grow = C.simulate(W.schedule(_spec(rate=60.0, duration_s=1.0,
+                                       new=14, prompt=6), seed=3),
+                      _profile(), slots=6, kv_blocks=6, block_size=8)
+    assert grow["completed"] > 0
+    assert grow["preempted"] > 0
+
+
+def test_predict_is_deterministic_and_internally_consistent():
+    spec = _spec(rate=30.0)
+    p = _profile()
+    kw = dict(slots=8, kv_blocks=48, block_size=8)
+    a = C.predict(spec, 7, p, **kw)
+    assert a == C.predict(spec, 7, p, **kw)
+    assert a["completed"] == a["events"] > 0
+    assert a["ttft_ms"]["p99"] >= a["ttft_ms"]["p50"]
+    assert a["token_ms"]["p99"] >= a["token_ms"]["p50"]
+    assert a["rho"] == pytest.approx(a["offered_rps"] / a["knee_rps"],
+                                     rel=1e-3)
+    # the p99s carry the fitted host-jitter tails
+    assert a["ttft_ms"]["p99"] >= a["ttft_ms"]["p50"] + p.ttft_tail_ms
+
+
+def test_committed_capacity_evidence_is_in_band():
+    """The committed HLO_EVIDENCE.json capacity_validation record must
+    hold: ok, headroom >= 1 (the perf floor), and all three builtin
+    specs scored by the hub."""
+    with open(os.path.join(REPO, "HLO_EVIDENCE.json")) as f:
+        section = json.load(f)["graphs"]["capacity_validation"]
+    assert section["ok"] is True
+    assert section["band_headroom_x"] >= 1.0
+    assert set(section["specs"]) == {"steady", "diurnal", "flash"}
+    for name, s in section["specs"].items():
+        assert s["ok"], name
+        assert s["observed"]["scored_by"] == "hub"
+        assert s["observed"]["errors"] == 0
+
+
+@pytest.mark.slow
+def test_validate_closed_loop_end_to_end(tmp_path):
+    """The real thing: calibrate a CPU profile, predict the builtin
+    trio, replay each through the harness with a live hub, and hold
+    every metric to its band. Serial-only (CPU timing)."""
+    import shutil
+
+    import capacity_plan
+
+    out = tmp_path / "evidence.json"
+    shutil.copy(os.path.join(REPO, "HLO_EVIDENCE.json"), out)
+    section = capacity_plan.validate(evidence_path=str(out))
+    assert section["ok"], json.dumps(section, indent=1)
+    with open(out) as f:
+        assert json.load(f)["graphs"]["capacity_validation"]["ok"]

@@ -224,3 +224,68 @@ def test_spmd_plan_pipeline_ep_prices_all_to_all(capsys):
     assert payload["expert"]["all_to_all_count"] > 0
     assert payload["expert"]["all_to_all_bytes"] > 0
     assert any("w_up" in t for t in payload["expert"]["rules"])
+
+
+def test_traffic_determinism_lint_detects_and_pragma_suppresses():
+    src = textwrap.dedent("""
+        import random
+        import time
+
+        import numpy as np
+
+
+        def bad_clock():
+            return time.time()
+
+
+        def bad_stdlib():
+            return random.uniform(0, 1)
+
+
+        def bad_global_numpy():
+            return np.random.rand(3)
+
+
+        def bad_unseeded_ctor():
+            return np.random.RandomState()
+
+
+        def allowed():
+            t = time.perf_counter()
+            time.sleep(0)
+            rng = np.random.RandomState(7)
+            waived = np.random.rand()  # lint: traffic-determinism-ok
+            return t, rng, waived
+    """)
+    with tempfile.TemporaryDirectory() as tmp:
+        with open(os.path.join(tmp, "mod.py"), "w") as f:
+            f.write(src)
+        problems = framework_lint.check_traffic_determinism(tmp)
+    assert any("time.time()" in p for p in problems), problems
+    assert any("random.uniform" in p for p in problems), problems
+    assert any("np.random.rand" in p for p in problems), problems
+    assert any("np.random.RandomState" in p and "seed" in p
+               for p in problems), problems
+    # exactly the four violations: perf_counter/sleep/seeded-ctor are
+    # allowed and the pragma'd global draw is waived
+    assert len(problems) == 4, problems
+
+
+def test_traffic_lab_itself_is_deterministic():
+    assert framework_lint.check_traffic_determinism() == []
+
+
+def test_tool_registry_completeness_detected():
+    with tempfile.TemporaryDirectory() as tmp:
+        with open(os.path.join(tmp, "rogue_tool.py"), "w") as f:
+            f.write("def self_check():\n    return []\n")
+        with open(os.path.join(tmp, "no_check_tool.py"), "w") as f:
+            f.write("def main():\n    return 0\n")
+        problems = framework_lint.check_tool_registry(tmp)
+    assert any("rogue_tool" in p and "TOOL_CROSS_CHECKS" in p
+               for p in problems), problems
+    assert not any("no_check_tool" in p for p in problems), problems
+
+
+def test_tool_registry_repo_is_complete():
+    assert framework_lint.check_tool_registry() == []

@@ -48,7 +48,6 @@ framework_lint TOOL_CROSS_CHECKS runs self_check() here: the
 PADDLE_TELEMETRY_* / PADDLE_SLO_* flag defaults and the
 docs/observability.md flag table must agree.
 """
-import itertools
 import json
 import os
 import subprocess
@@ -165,26 +164,6 @@ def member_server(idx, hub_ep, dim):
     return 0
 
 
-class _Window:
-    """Expose the shared streaming generator to train_from_dataset a
-    fixed number of batches at a time (one trainer session per round
-    over the same exactly-once stream)."""
-
-    def __init__(self, ds):
-        self.ds = ds
-        self._gen = None
-        self.n = 0
-
-    def take(self, n):
-        self.n = int(n)
-        return self
-
-    def batches(self, start_batch=0):
-        if self._gen is None:
-            self._gen = self.ds.batches(start_batch=start_batch)
-        return itertools.islice(self._gen, self.n)
-
-
 def member_client(eps, hub_ep):
     """The serve + online-train member: a tiny-GPT ServeLoop feeding a
     StreamingDataset feeding the continuous Downpour trainer, run under
@@ -198,6 +177,7 @@ def member_client(eps, hub_ep):
     from paddle_tpu.inference import ServeConfig, ServeLoop
     from paddle_tpu.testing import faults
     from paddle_tpu.text.models.gpt import GPT, GPTConfig
+    from paddle_tpu.traffic import harness
 
     paddle.seed(0)
     cfg = GPTConfig.tiny()
@@ -230,17 +210,19 @@ def member_client(eps, hub_ep):
     emb_name = emb.weight.scope_name
     exe = static.Executor()
     client_t = PSClient(eps, **FAST)
-    window = _Window(ds)
+    window = harness.Window(ds)
     holder = {}
     state = None
 
     def serve_phase(k):
         rng = np.random.RandomState(1000 + k)
-        reqs = [loop.submit(rng.randint(0, 48, 4).astype(np.int64),
-                            max_new_tokens=NEW) for _ in range(REQS)]
-        loop.run_until_idle()
-        for r in reqs:
-            r.result(timeout=300)
+        prompts = [rng.randint(0, 48, 4).astype(np.int64)
+                   for _ in range(REQS)]
+        stats = harness.drive_serve(
+            loop, harness.submissions_from_prompts(prompts, NEW),
+            wait="idle+result", result_timeout_s=300.0)
+        if stats.errors:      # parent records the crash as a violation
+            raise RuntimeError("; ".join(stats.errors))
 
     def train_phase(n_batches):
         nonlocal state
@@ -566,6 +548,14 @@ def self_check():
         problems.append(
             f"cluster_obs_drill: incident schema cross-check failed: "
             f"{e!r}")
+    with open(os.path.abspath(__file__)) as f:
+        self_src = f.read()
+    for token in ("harness.drive_serve", "harness.Window"):
+        if token not in self_src:
+            problems.append(f"cluster_obs_drill: the serve/window "
+                            f"plumbing must come from "
+                            f"paddle_tpu.traffic.harness (`{token}` "
+                            f"missing)")
     return problems
 
 

@@ -363,7 +363,38 @@ TOOL_CROSS_CHECKS = ["spmd_lint", "spmd_plan", "hlo_evidence",
                      "pipeline_lint", "obs_report", "ps_load_test",
                      "elastic_drill", "serve_load_test",
                      "pp_schedule_report", "online_drill",
-                     "cluster_obs_drill"]
+                     "cluster_obs_drill", "capacity_plan"]
+
+
+def check_tool_registry(tools_dir=None):
+    """Every tools/*.py that defines a top-level self_check() must be
+    listed in TOOL_CROSS_CHECKS — an unregistered self_check is a lint
+    nobody runs, which is how cross-checks silently rot."""
+    import ast
+    problems = []
+    tools_dir = tools_dir or os.path.dirname(os.path.abspath(__file__))
+    for fname in sorted(os.listdir(tools_dir)):
+        if not fname.endswith(".py"):
+            continue
+        mod_name = fname[:-3]
+        if mod_name == "framework_lint":
+            continue          # the registry itself, not a registrant
+        try:
+            with open(os.path.join(tools_dir, fname)) as f:
+                tree = ast.parse(f.read(), filename=fname)
+        except SyntaxError as e:
+            problems.append(f"tool registry: tools/{fname} does not "
+                            f"parse: {e}")
+            continue
+        has_self_check = any(
+            isinstance(node, ast.FunctionDef) and node.name == "self_check"
+            for node in tree.body)
+        if has_self_check and mod_name not in TOOL_CROSS_CHECKS:
+            problems.append(
+                f"tool registry: tools/{fname} defines self_check() but "
+                "is not listed in framework_lint.TOOL_CROSS_CHECKS — "
+                "register it so the gate actually runs it")
+    return problems
 
 
 def check_registered_tools():
@@ -414,6 +445,11 @@ PERF_FLOORS = [
     ("hierarchical dp sync inter-pod wire-bytes reduction",
      ("graphs", "hierarchical_sync", "wire_model",
       "inter_pod_reduction_x"), 2.0),
+    # capacity model held inside its declared error bands when last
+    # validated against the hub (tools/capacity_plan.py --validate);
+    # headroom < 1.0 means a metric escaped its band
+    ("capacity model validated within band",
+     ("graphs", "capacity_validation", "band_headroom_x"), 1.0),
 ]
 
 
@@ -500,14 +536,109 @@ def check_doc_flags(docs_dir=DOCS_DIR):
 
 
 # ---------------------------------------------------------------------------
+# check 6: the traffic lab must stay deterministic
+# ---------------------------------------------------------------------------
+
+TRAFFIC_DIR = os.path.join(REPO, "paddle_tpu", "traffic")
+
+# suppression pragma for a deliberate, reviewed exception
+_DETERMINISM_PRAGMA = "lint: traffic-determinism-ok"
+
+
+def _attr_chain(node):
+    """Dotted name of an attribute access ('np.random.RandomState'),
+    or None for anything fancier than Name.attr.attr..."""
+    import ast
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def check_traffic_determinism(traffic_dir=None):
+    """Replayability is paddle_tpu/traffic/'s contract: every draw comes
+    from a named, seeded stream. This AST lint forbids the ambient
+    entropy sources that silently break byte-identical replay:
+
+      - `time.time()` / `time.time_ns()` (wall clock in generated data;
+        `time.perf_counter`/`time.sleep` pacing is fine)
+      - any call through the stdlib `random` module (global PRNG)
+      - `numpy.random` module-level draws (`np.random.rand(...)` uses
+        global state) and UNSEEDED constructors (`np.random.RandomState()`
+        / `np.random.default_rng()` with no arguments)
+
+    A deliberate exception carries the `# lint: traffic-determinism-ok`
+    pragma on the offending line."""
+    import ast
+    problems = []
+    traffic_dir = traffic_dir or TRAFFIC_DIR
+    if not os.path.isdir(traffic_dir):
+        return [f"traffic determinism: {traffic_dir} missing"]
+    seeded_ctors = {"RandomState", "default_rng", "Generator",
+                    "SeedSequence"}
+    for fname in sorted(os.listdir(traffic_dir)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(traffic_dir, fname)
+        with open(path) as f:
+            src = f.read()
+        lines = src.splitlines()
+        try:
+            tree = ast.parse(src, filename=fname)
+        except SyntaxError as e:
+            problems.append(
+                f"traffic determinism: {fname} does not parse: {e}")
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = _attr_chain(node.func)
+            if chain is None:
+                continue
+            bad = None
+            if chain in ("time.time", "time.time_ns"):
+                bad = f"{chain}() (wall clock)"
+            elif chain.startswith("random."):
+                bad = f"{chain}() (global stdlib PRNG)"
+            if bad is None:
+                head, _, tail = chain.rpartition(".")
+                if head in ("np.random", "numpy.random"):
+                    if tail in seeded_ctors:
+                        if not node.args and not node.keywords:
+                            bad = (f"{chain}() without a seed "
+                                   "(nondeterministic entropy)")
+                    else:
+                        bad = f"{chain}() (global numpy PRNG state)"
+            if bad is None:
+                continue
+            line = lines[node.lineno - 1] if node.lineno <= len(lines) \
+                else ""
+            if _DETERMINISM_PRAGMA in line:
+                continue
+            problems.append(
+                f"traffic determinism: paddle_tpu/traffic/{fname}:"
+                f"{node.lineno} calls {bad} — every draw must come from "
+                "a named seeded stream (workload.Stream); add "
+                f"`# {_DETERMINISM_PRAGMA}` only for a reviewed "
+                "exception")
+    return problems
+
+
+# ---------------------------------------------------------------------------
 
 def run_lint(spec_path=SPEC_PATH, versions_path=VERSIONS_PATH,
              ops_dir=OPS_DIR):
     problems = check_registry_spec(spec_path, versions_path)
     problems += check_concretization(ops_dir)
     problems += check_perf_floors()
+    problems += check_tool_registry()
     problems += check_registered_tools()
     problems += check_doc_flags()
+    problems += check_traffic_determinism()
     return problems
 
 

@@ -26,7 +26,7 @@ NAMESPACES = [
     "paddle_tpu.profiler", "paddle_tpu.memory", "paddle_tpu.quantization",
     "paddle_tpu.distribution", "paddle_tpu.incubate.checkpoint",
     "paddle_tpu.vision.ops", "paddle_tpu.utils", "paddle_tpu.callbacks",
-    "paddle_tpu.onnx", "paddle_tpu.reader",
+    "paddle_tpu.onnx", "paddle_tpu.reader", "paddle_tpu.traffic",
 ]
 
 

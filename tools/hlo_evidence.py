@@ -690,6 +690,17 @@ def run(out_path="HLO_EVIDENCE.json", tiny=False):
         paddle.set_flags({k: v for k, v in saved.items()})
 
     report["ok"] = all(a["ok"] for a in report["assertions"])
+    # sections other tools own ride through a regeneration: the capacity
+    # validation record (tools/capacity_plan.py --validate) is gated by
+    # check_perf_floors, so dropping it here would fail the build
+    try:
+        with open(out_path) as f:
+            prior = json.load(f)
+        for key in ("capacity_validation",):
+            if key in prior.get("graphs", {}):
+                report["graphs"].setdefault(key, prior["graphs"][key])
+    except (OSError, ValueError):
+        pass
     with open(out_path, "w") as f:
         json.dump(report, f, indent=1, sort_keys=True)
     return report

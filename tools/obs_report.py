@@ -201,7 +201,8 @@ SERVE_GAUGES = ("serve.queue_depth", "serve.active_slots",
                 "serve.model_version")
 SERVE_COUNTERS = ("serve.preempted", "serve.tokens_generated",
                   "serve.requests_completed", "serve.requests_errored",
-                  "serve.hot_swaps", "serve.completion_log_errors")
+                  "serve.hot_swaps", "serve.completion_log_errors",
+                  "serve.backpressure_waits")
 _SERVE_SPANS = ("serve/admit", "serve/prefill", "serve/decode_step",
                 "serve/retire", "serve/evict", "serve/hot_swap")
 

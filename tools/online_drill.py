@@ -38,7 +38,6 @@ PADDLE_STREAM_* / PADDLE_ONLINE_* flag defaults, bench.py's
 BENCH_ONLINE_* online-mode knobs, and docs/online_learning.md must
 agree.
 """
-import itertools
 import json
 import os
 import sys
@@ -81,26 +80,6 @@ FAST = dict(timeout=2.0, max_retries=2, backoff_base=0.01,
 HB = dict(heartbeat_s=0.1, heartbeat_timeout_s=0.7)
 
 
-class _Window:
-    """Expose the shared streaming generator to train_from_dataset a
-    fixed number of batches at a time (one trainer session per round
-    over the same exactly-once stream)."""
-
-    def __init__(self, ds):
-        self.ds = ds
-        self._gen = None
-        self.n = 0
-
-    def take(self, n):
-        self.n = int(n)
-        return self
-
-    def batches(self, start_batch=0):
-        if self._gen is None:
-            self._gen = self.ds.batches(start_batch=start_batch)
-        return itertools.islice(self._gen, self.n)
-
-
 def run():
     import paddle_tpu as paddle
     from paddle_tpu import static
@@ -114,6 +93,7 @@ def run():
     from paddle_tpu.inference import ServeConfig, ServeLoop
     from paddle_tpu.testing import faults
     from paddle_tpu.text.models.gpt import GPT, GPTConfig
+    from paddle_tpu.traffic import harness
 
     if REQS % BATCH:
         print(f"ONLINE_DRILL_REQS={REQS} must be a multiple of "
@@ -179,7 +159,7 @@ def run():
     cache = HeterPSCache(client_p, "wte", dim, capacity=256, host_rows=0)
     pub = EmbeddingSnapshotPublisher(client_p, "wte", cache=cache)
     prefetchers = []
-    window = _Window(ds)
+    window = harness.Window(ds)
     holder = {}
     all_reqs = []
     snaps = []
@@ -187,10 +167,14 @@ def run():
 
     def serve_phase(k):
         rng = np.random.RandomState(1000 + k)
-        reqs = [loop.submit(rng.randint(0, 48, 4).astype(np.int64),
-                            max_new_tokens=NEW) for _ in range(REQS)]
-        loop.run_until_idle()
-        all_reqs.extend(reqs)
+        prompts = [rng.randint(0, 48, 4).astype(np.int64)
+                   for _ in range(REQS)]
+        stats = harness.drive_serve(
+            loop, harness.submissions_from_prompts(prompts, NEW),
+            wait="idle")
+        for e in stats.errors:
+            violations.append(f"serve phase {k}: {e}")
+        all_reqs.extend(r for r in stats.requests if r is not None)
 
     def train_phase(n_batches):
         pf = EmbeddingPrefetcher(client_t, table="wte")
@@ -419,6 +403,11 @@ def self_check():
     if "from paddle_tpu.core.slo import percentile" not in self_src:
         problems.append("online_drill: report ttft percentiles must "
                         "come from core.slo.percentile")
+    for token in ("harness.drive_serve", "harness.Window"):
+        if token not in self_src:
+            problems.append(f"online_drill: the serve/window plumbing "
+                            f"must come from paddle_tpu.traffic.harness "
+                            f"(`{token}` missing)")
     return problems
 
 

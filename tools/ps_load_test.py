@@ -35,7 +35,6 @@ failover/sharded-mode knobs, and docs/fault_tolerance.md must agree.
 """
 import os
 import sys
-import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -143,24 +142,11 @@ def run_failover():
         results[wid] = (pushed, time.perf_counter() - t0)
         client.close()
 
-    promotions0 = monitor.stat_get("ps.replica.promotions")
-    threads = [threading.Thread(target=worker, args=(w,))
-               for w in range(WORKERS)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    time.sleep(0.5)
-    t_kill = time.perf_counter()
-    servers[0].shutdown()                 # permanent primary kill
-    promote_latency = None
-    while time.perf_counter() - t_kill < 30.0:
-        if monitor.stat_get("ps.replica.promotions") > promotions0:
-            promote_latency = time.perf_counter() - t_kill
-            break
-        time.sleep(0.005)
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
+    from paddle_tpu.traffic import harness
+    pool = harness.run_worker_pool(worker, WORKERS, kill_after_s=0.5,
+                                   on_kill=servers[0].shutdown)
+    promote_latency = pool.promote_latency_s
+    wall = pool.wall_s
     for s in servers[1:]:
         s.shutdown()
 
@@ -250,24 +236,11 @@ def run_sharded():
         results[wid] = (pulled, time.perf_counter() - t0, stats,
                         my_shard_rows)
 
-    promotions0 = monitor.stat_get("ps.replica.promotions")
-    threads = [threading.Thread(target=worker, args=(w,))
-               for w in range(WORKERS)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    time.sleep(0.5)
-    t_kill = time.perf_counter()
-    servers[0].shutdown()                 # permanent shard-primary kill
-    promote_latency = None
-    while time.perf_counter() - t_kill < 30.0:
-        if monitor.stat_get("ps.replica.promotions") > promotions0:
-            promote_latency = time.perf_counter() - t_kill
-            break
-        time.sleep(0.005)
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
+    from paddle_tpu.traffic import harness
+    pool = harness.run_worker_pool(worker, WORKERS, kill_after_s=0.5,
+                                   on_kill=servers[0].shutdown)
+    promote_latency = pool.promote_latency_s
+    wall = pool.wall_s
     for s in servers[1:]:
         s.shutdown()
 
@@ -360,6 +333,10 @@ def self_check():
     if "from paddle_tpu.core.slo import percentile" not in self_src:
         problems.append("ps_load_test: round-latency percentiles must "
                         "come from core.slo.percentile")
+    if "harness.run_worker_pool" not in self_src:
+        problems.append("ps_load_test: the worker pool / kill-and-promote "
+                        "loop must be the shared "
+                        "paddle_tpu.traffic.harness.run_worker_pool")
     return problems
 
 
@@ -382,15 +359,10 @@ def main():
     try:
         endpoints = [srv.endpoint]
         results = {}
-        threads = [threading.Thread(target=run_worker,
-                                    args=(endpoints, w, results))
-                   for w in range(WORKERS)]
-        t0 = time.perf_counter()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
+        from paddle_tpu.traffic import harness
+        wall = harness.run_worker_pool(
+            lambda wid: run_worker(endpoints, wid, results),
+            WORKERS).wall_s
     finally:
         srv.shutdown()
 

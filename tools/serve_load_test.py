@@ -32,8 +32,6 @@ tools/hlo_evidence.py's SERVE_CFG, and docs/serving.md must agree.
 import json
 import os
 import sys
-import threading
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -76,6 +74,7 @@ def run():
     from paddle_tpu.core import monitor
     from paddle_tpu.inference import ServeConfig, ServeLoop
     from paddle_tpu.text.models.gpt import GPT, GPTConfig
+    from paddle_tpu.traffic import harness
 
     paddle.seed(0)
     cfg = GPTConfig.tiny()
@@ -104,44 +103,24 @@ def run():
     monitor.reset(prefix="serve.")
     loop.start()
 
-    reqs = [None] * STREAMS
-    errors = []
-    lock = threading.Lock()
-
-    def client(cid):
+    # same jitter stream the hand-rolled client loop drew: client `cid`
+    # takes the stride cid, cid+CLIENTS, ... and sleeps a fresh
+    # RandomState(1000+cid).uniform(0, 2ms) before each submit — the
+    # harness honors per-submission delays in that exact stride order
+    delays = [0.0] * STREAMS
+    for cid in range(CLIENTS):
         crng = np.random.RandomState(1000 + cid)
         for i in range(cid, STREAMS, CLIENTS):
-            time.sleep(float(crng.uniform(0, 0.002)))  # jittered arrival
-            try:
-                reqs[i] = loop.submit(prompts[i], max_new_tokens=NEW)
-            except Exception as e:  # noqa: BLE001
-                with lock:
-                    errors.append(f"submit[{i}]: {type(e).__name__}: {e}")
-
-    t0 = time.perf_counter()
-    ths = [threading.Thread(target=client, args=(c,))
-           for c in range(CLIENTS)]
-    for t in ths:
-        t.start()
-    for t in ths:
-        t.join()
-    outs = [None] * STREAMS
-    toks = 0
-    ttfts, per_tok = [], []
-    for i, r in enumerate(reqs):
-        if r is None:
-            continue
-        try:
-            outs[i] = r.result(timeout=600)
-            toks += len(outs[i])
-            if r.ttft_s is not None:
-                ttfts.append(r.ttft_s * 1e3)
-            if r.per_token_s is not None:
-                per_tok.append(r.per_token_s * 1e3)
-        except Exception as e:  # noqa: BLE001
-            errors.append(f"result[{i}]: {type(e).__name__}: {e}")
-    dt = time.perf_counter() - t0
+            delays[i] = float(crng.uniform(0, 0.002))
+    stats = harness.drive_serve(
+        loop, harness.submissions_from_prompts(prompts, NEW, delays),
+        clients=CLIENTS, wait="result", result_timeout_s=600.0)
     loop.stop()
+    outs = stats.outs
+    toks = stats.tokens
+    ttfts, per_tok = stats.ttfts_ms, stats.token_ms
+    errors = stats.errors
+    dt = stats.wall_s
 
     verified = 0
     if VERIFY:
@@ -289,6 +268,9 @@ def self_check():
     if "from paddle_tpu.core.slo import percentile" not in self_src:
         problems.append("serve_load_test: report percentiles must come "
                         "from core.slo.percentile (shared estimator)")
+    if "harness.drive_serve" not in self_src:
+        problems.append("serve_load_test: the client submit loop must be "
+                        "the shared paddle_tpu.traffic.harness.drive_serve")
     return problems
 
 
